@@ -1,0 +1,86 @@
+#!/usr/bin/env python
+"""Benchmark harness: trains the flagship BASELINE config on the real chip and
+prints ONE JSON line {"metric", "value", "unit", "vs_baseline"}.
+
+Primary metric: ResNet-50 ComputationGraph.fit() samples/sec/chip (BASELINE
+config #2 / north star). Falls back to LeNet/MNIST (config #1) if the chip
+can't fit ResNet-50. `vs_baseline` is value / 1000 samples/sec — a generous
+stand-in for the reference nd4j-cuda stack on A100 (the reference publishes no
+numbers; see BASELINE.md), so >1.0 means faster than the assumed baseline.
+"""
+from __future__ import annotations
+
+import json
+import sys
+import time
+
+import numpy as np
+
+
+ASSUMED_BASELINE_SAMPLES_PER_SEC = 1000.0
+
+
+def bench_resnet50(batch=32, image=224, steps=8, warmup=2):
+    import jax
+    import jax.numpy as jnp
+    from deeplearning4j_tpu.zoo.models import resnet50
+    from deeplearning4j_tpu.datasets.dataset import DataSet
+    from deeplearning4j_tpu.nn.updaters import Nesterovs
+
+    net = resnet50(num_classes=1000, image_size=image,
+                   updater=Nesterovs(learning_rate=0.05, momentum=0.9))
+    net.init()
+    rng = np.random.default_rng(0)
+    x = rng.normal(size=(batch, image, image, 3)).astype(np.float32)
+    y = np.eye(1000, dtype=np.float32)[rng.integers(0, 1000, batch)]
+    ds = DataSet(x, y)
+    for _ in range(warmup):
+        net.fit_batch(ds)
+    jax.block_until_ready(net.params)
+    t0 = time.perf_counter()
+    for _ in range(steps):
+        net.fit_batch(ds)
+    jax.block_until_ready(net.params)
+    dt = time.perf_counter() - t0
+    return batch * steps / dt, "resnet50_train_samples_per_sec_per_chip"
+
+
+def bench_lenet(batch=128, steps=20, warmup=3):
+    import jax
+    from deeplearning4j_tpu.zoo.models import lenet_mnist
+    from deeplearning4j_tpu.datasets.dataset import DataSet
+
+    net = lenet_mnist()
+    net.init()
+    rng = np.random.default_rng(0)
+    x = rng.random((batch, 28, 28, 1)).astype(np.float32)
+    y = np.eye(10, dtype=np.float32)[rng.integers(0, 10, batch)]
+    ds = DataSet(x, y)
+    for _ in range(warmup):
+        net.fit_batch(ds)
+    jax.block_until_ready(net.params)
+    t0 = time.perf_counter()
+    for _ in range(steps):
+        net.fit_batch(ds)
+    jax.block_until_ready(net.params)
+    dt = time.perf_counter() - t0
+    return batch * steps / dt, "lenet_mnist_train_samples_per_sec_per_chip"
+
+
+def main():
+    try:
+        value, metric = bench_resnet50()
+    except Exception as e:  # OOM / compile failure: fall back, still emit JSON
+        print(f"resnet50 bench failed ({type(e).__name__}: {e}); falling back to LeNet",
+              file=sys.stderr)
+        value, metric = bench_lenet()
+    print(json.dumps({
+        "metric": metric,
+        "value": round(float(value), 2),
+        "unit": "samples/sec/chip",
+        "vs_baseline": round(float(value) / ASSUMED_BASELINE_SAMPLES_PER_SEC, 3),
+    }))
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,37 @@
+"""deeplearning4j-tpu: a TPU-native deep learning framework with the
+capabilities of deeplearning4j (reference: puchka/deeplearning4j v0.7.3),
+re-designed on JAX/XLA — whole-step compilation, SPMD sharding over device
+meshes, lax control flow for recurrence, NHWC/MXU-friendly layouts.
+"""
+from .nn.conf.configuration import (NeuralNetConfiguration, MultiLayerConfiguration,
+                                    BackpropType, OptimizationAlgorithm)
+from .nn.conf.inputs import InputType
+from .nn.conf import layers
+from .nn.conf.layers import (DenseLayer, OutputLayer, RnnOutputLayer, LossLayer,
+                             CenterLossOutputLayer, EmbeddingLayer, ConvolutionLayer,
+                             SubsamplingLayer, BatchNormalization,
+                             LocalResponseNormalization, GravesLSTM, LSTM,
+                             GravesBidirectionalLSTM, ActivationLayer, DropoutLayer,
+                             GlobalPoolingLayer, ZeroPaddingLayer, AutoEncoder, RBM,
+                             VariationalAutoencoder)
+from .nn.updaters import (Sgd, Adam, AdaMax, AdaDelta, AdaGrad, RmsProp, Nesterovs,
+                          NoOp, GradientNormalization)
+from .nn.weights import WeightInit
+from .nn.multilayer.network import MultiLayerNetwork
+from .nn.graph.graph import ComputationGraph
+from .nn.conf.graph_configuration import (ComputationGraphConfiguration,
+                                          ElementWiseVertex, MergeVertex,
+                                          SubsetVertex, StackVertex, UnstackVertex,
+                                          ScaleVertex, L2NormalizeVertex, L2Vertex,
+                                          PreprocessorVertex, LastTimeStepVertex,
+                                          DuplicateToTimeSeriesVertex)
+from .util.model_serializer import ModelSerializer, ModelGuesser
+from .datasets.dataset import DataSet, MultiDataSet
+from .datasets.iterator.base import (DataSetIterator, ListDataSetIterator,
+                                     INDArrayDataSetIterator, AsyncDataSetIterator,
+                                     MultipleEpochsIterator, ExistingDataSetIterator)
+from .eval.evaluation import Evaluation
+from .optimize.listeners import (ScoreIterationListener, PerformanceListener,
+                                 CollectScoresIterationListener)
+
+__version__ = "0.1.0"

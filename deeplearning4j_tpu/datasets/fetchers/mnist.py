@@ -1,0 +1,128 @@
+"""MNIST fetcher + iterator.
+
+Reference: deeplearning4j-core datasets/fetchers/MnistDataFetcher.java,
+base/MnistFetcher.java:67 (downloadAndUntar, retry :103-107), raw IDX parsing
+in datasets/mnist/{MnistDbFile,MnistImageFile,MnistLabelFile,MnistManager}.java,
+iterator datasets/iterator/impl/MnistDataSetIterator.java.
+
+This environment has no egress, so the fetcher looks for local copies
+(MNIST_DIR env var, ~/.deeplearning4j_tpu/mnist, torchvision cache) and
+otherwise falls back to a deterministic synthetic digit set so tests and
+benchmarks run hermetically (generation is class-conditional so models can
+actually learn; clearly labeled synthetic).
+"""
+from __future__ import annotations
+
+import gzip
+import os
+import struct
+
+import numpy as np
+
+from ..dataset import DataSet
+from ..iterator.base import DataSetIterator
+
+_CACHE = {}
+
+
+def _read_idx_images(path):
+    op = gzip.open if path.endswith(".gz") else open
+    with op(path, "rb") as f:
+        magic, n, rows, cols = struct.unpack(">IIII", f.read(16))
+        assert magic == 2051, f"bad magic {magic}"
+        data = np.frombuffer(f.read(n * rows * cols), dtype=np.uint8)
+        return data.reshape(n, rows, cols)
+
+
+def _read_idx_labels(path):
+    op = gzip.open if path.endswith(".gz") else open
+    with op(path, "rb") as f:
+        magic, n = struct.unpack(">II", f.read(8))
+        assert magic == 2049, f"bad magic {magic}"
+        return np.frombuffer(f.read(n), dtype=np.uint8)
+
+
+def _find_mnist_files(train):
+    prefix = "train" if train else "t10k"
+    candidates = [
+        os.environ.get("MNIST_DIR"),
+        os.path.expanduser("~/.deeplearning4j_tpu/mnist"),
+        os.path.expanduser("~/.cache/mnist"),
+        "/root/data/mnist",
+        "/data/mnist",
+    ]
+    for d in candidates:
+        if not d or not os.path.isdir(d):
+            continue
+        for suffix in ("", ".gz"):
+            img = os.path.join(d, f"{prefix}-images-idx3-ubyte{suffix}")
+            lab = os.path.join(d, f"{prefix}-labels-idx1-ubyte{suffix}")
+            if os.path.exists(img) and os.path.exists(lab):
+                return img, lab
+    return None, None
+
+
+def _synthetic_mnist(n, seed):
+    """Deterministic class-conditional synthetic digits: each class is a fixed
+    random 28x28 prototype plus noise. Learnable and hermetic."""
+    rng = np.random.default_rng(seed)
+    protos = np.random.default_rng(1234).random((10, 28, 28)).astype(np.float32)
+    labels = rng.integers(0, 10, n)
+    imgs = protos[labels] + 0.35 * rng.standard_normal((n, 28, 28)).astype(np.float32)
+    imgs = np.clip(imgs, 0.0, 1.0)
+    return imgs.astype(np.float32), labels.astype(np.int64)
+
+
+def load_mnist(train=True, num_examples=None):
+    """Returns (images [n,28,28] float32 in [0,1], labels [n] int64)."""
+    key = (train, num_examples)
+    if key in _CACHE:
+        return _CACHE[key]
+    img_path, lab_path = _find_mnist_files(train)
+    if img_path:
+        imgs = _read_idx_images(img_path).astype(np.float32) / 255.0
+        labels = _read_idx_labels(lab_path).astype(np.int64)
+    else:
+        n = num_examples or (60000 if train else 10000)
+        imgs, labels = _synthetic_mnist(n, seed=0 if train else 1)
+    if num_examples is not None:
+        imgs, labels = imgs[:num_examples], labels[:num_examples]
+    _CACHE[key] = (imgs, labels)
+    return imgs, labels
+
+
+class MnistDataSetIterator(DataSetIterator):
+    """(reference: datasets/iterator/impl/MnistDataSetIterator.java)
+    Emits NHWC image batches [b,28,28,1] (or flat [b,784] if flatten=True)
+    with one-hot labels [b,10]."""
+
+    def __init__(self, batch_size, train=True, num_examples=None, flatten=False,
+                 shuffle=True, seed=123, binarize=False):
+        self.batch_size = int(batch_size)
+        self.flatten = flatten
+        imgs, labels = load_mnist(train, num_examples)
+        if binarize:
+            imgs = (imgs > 0.5).astype(np.float32)
+        if shuffle:
+            idx = np.random.default_rng(seed).permutation(len(imgs))
+            imgs, labels = imgs[idx], labels[idx]
+        self._x = imgs.reshape(len(imgs), -1) if flatten else imgs[..., None]
+        self._y = np.eye(10, dtype=np.float32)[labels]
+        self._i = 0
+
+    def next(self):
+        s, e = self._i, min(self._i + self.batch_size, len(self._x))
+        self._i = e
+        return DataSet(self._x[s:e], self._y[s:e])
+
+    def has_next(self):
+        return self._i < len(self._x)
+
+    def reset(self):
+        self._i = 0
+
+    def batch(self):
+        return self.batch_size
+
+    def total_examples(self):
+        return len(self._x)

@@ -1,0 +1,116 @@
+"""Classification evaluation: accuracy/precision/recall/F1 + confusion matrix.
+
+Reference: eval/Evaluation.java, eval/ConfusionMatrix.java. Supports masked
+time-series evaluation (evalTimeSeries) like the reference.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+
+class ConfusionMatrix:
+    def __init__(self, n_classes):
+        self.matrix = np.zeros((n_classes, n_classes), dtype=np.int64)
+
+    def add(self, actual, predicted, count=1):
+        self.matrix[actual, predicted] += count
+
+    def get_count(self, actual, predicted):
+        return int(self.matrix[actual, predicted])
+
+    def __str__(self):
+        return str(self.matrix)
+
+
+class Evaluation:
+    def __init__(self, n_classes=None, labels=None):
+        self.n_classes = n_classes
+        self.label_names = labels
+        self.confusion = None
+
+    def _ensure(self, n):
+        if self.confusion is None:
+            self.n_classes = self.n_classes or n
+            self.confusion = ConfusionMatrix(self.n_classes)
+
+    def eval(self, labels, predictions, mask=None):
+        """labels/predictions: [batch, n_classes] probabilities/one-hot, or
+        [batch, time, n_classes] with mask [batch, time]."""
+        labels = np.asarray(labels)
+        predictions = np.asarray(predictions)
+        if labels.ndim == 3:
+            b, t, c = labels.shape
+            labels = labels.reshape(b * t, c)
+            predictions = predictions.reshape(b * t, c)
+            if mask is not None:
+                m = np.asarray(mask).reshape(b * t) > 0
+                labels, predictions = labels[m], predictions[m]
+        elif mask is not None:
+            m = np.asarray(mask).reshape(-1) > 0
+            labels, predictions = labels[m], predictions[m]
+        self._ensure(labels.shape[-1])
+        actual = np.argmax(labels, axis=-1)
+        pred = np.argmax(predictions, axis=-1)
+        np.add.at(self.confusion.matrix, (actual, pred), 1)
+
+    def eval_time_series(self, labels, predictions, mask=None):
+        self.eval(labels, predictions, mask)
+
+    # ---- metrics ----------------------------------------------------------
+    def _tp(self, i):
+        return self.confusion.matrix[i, i]
+
+    def _fp(self, i):
+        return self.confusion.matrix[:, i].sum() - self._tp(i)
+
+    def _fn(self, i):
+        return self.confusion.matrix[i, :].sum() - self._tp(i)
+
+    def accuracy(self):
+        m = self.confusion.matrix
+        total = m.sum()
+        return float(np.trace(m) / total) if total else 0.0
+
+    def precision(self, i=None):
+        if i is not None:
+            d = self._tp(i) + self._fp(i)
+            return float(self._tp(i) / d) if d else 0.0
+        vals = [self.precision(c) for c in range(self.n_classes)
+                if (self.confusion.matrix[c, :].sum() + self.confusion.matrix[:, c].sum()) > 0]
+        return float(np.mean(vals)) if vals else 0.0
+
+    def recall(self, i=None):
+        if i is not None:
+            d = self._tp(i) + self._fn(i)
+            return float(self._tp(i) / d) if d else 0.0
+        vals = [self.recall(c) for c in range(self.n_classes)
+                if self.confusion.matrix[c, :].sum() > 0]
+        return float(np.mean(vals)) if vals else 0.0
+
+    def f1(self, i=None):
+        p, r = self.precision(i), self.recall(i)
+        return 2 * p * r / (p + r) if (p + r) else 0.0
+
+    def false_positive_rate(self, i):
+        tn = self.confusion.matrix.sum() - self._tp(i) - self._fp(i) - self._fn(i)
+        d = self._fp(i) + tn
+        return float(self._fp(i) / d) if d else 0.0
+
+    def stats(self):
+        lines = [
+            "========================= Evaluation =========================",
+            f" Examples:  {int(self.confusion.matrix.sum())}",
+            f" Accuracy:  {self.accuracy():.4f}",
+            f" Precision: {self.precision():.4f}",
+            f" Recall:    {self.recall():.4f}",
+            f" F1 Score:  {self.f1():.4f}",
+            "Confusion matrix (rows=actual, cols=predicted):",
+            str(self.confusion),
+        ]
+        return "\n".join(lines)
+
+    def merge(self, other):
+        if other.confusion is not None:
+            self._ensure(other.n_classes)
+            self.confusion.matrix += other.confusion.matrix
+        return self

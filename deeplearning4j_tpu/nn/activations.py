@@ -1,0 +1,135 @@
+"""Activation functions.
+
+Capability parity with the reference's IActivation set (reference:
+nd4j `org.nd4j.linalg.activations.Activation`, consumed throughout
+deeplearning4j-nn — see e.g. nn/conf/NeuralNetConfiguration.java builder
+`.activation(...)`). TPU-first design: plain jnp functions; derivatives come
+from JAX autodiff rather than hand-written `backprop(in, epsilon)` pairs.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+_REGISTRY: dict = {}
+
+
+def register_activation(name):
+    def deco(fn):
+        _REGISTRY[name.lower()] = fn
+        return fn
+    return deco
+
+
+def get_activation(name):
+    """Resolve an activation by name (case-insensitive) or pass a callable through."""
+    if callable(name):
+        return name
+    key = str(name).lower()
+    if key not in _REGISTRY:
+        raise ValueError(f"Unknown activation '{name}'. Known: {sorted(_REGISTRY)}")
+    return _REGISTRY[key]
+
+
+def activation_names():
+    return sorted(_REGISTRY)
+
+
+@register_activation("identity")
+@register_activation("linear")
+def identity(x):
+    return x
+
+
+@register_activation("relu")
+def relu(x):
+    return jax.nn.relu(x)
+
+
+@register_activation("leakyrelu")
+def leakyrelu(x, alpha=0.01):
+    return jax.nn.leaky_relu(x, negative_slope=alpha)
+
+
+@register_activation("tanh")
+def tanh(x):
+    return jnp.tanh(x)
+
+
+@register_activation("sigmoid")
+def sigmoid(x):
+    return jax.nn.sigmoid(x)
+
+
+@register_activation("softmax")
+def softmax(x):
+    return jax.nn.softmax(x, axis=-1)
+
+
+@register_activation("softplus")
+def softplus(x):
+    return jax.nn.softplus(x)
+
+
+@register_activation("softsign")
+def softsign(x):
+    return jax.nn.soft_sign(x)
+
+
+@register_activation("elu")
+def elu(x, alpha=1.0):
+    return jax.nn.elu(x, alpha=alpha)
+
+
+@register_activation("selu")
+def selu(x):
+    return jax.nn.selu(x)
+
+
+@register_activation("hardtanh")
+def hardtanh(x):
+    return jnp.clip(x, -1.0, 1.0)
+
+
+@register_activation("hardsigmoid")
+def hardsigmoid(x):
+    return jnp.clip(0.2 * x + 0.5, 0.0, 1.0)
+
+
+@register_activation("cube")
+def cube(x):
+    return x ** 3
+
+
+@register_activation("rationaltanh")
+def rationaltanh(x):
+    # 1.7159 * tanh(2x/3) approximated rationally; the reference's RationalTanh
+    # uses f(x) = 1.7159 * softsign-style rational approximation.
+    a = jnp.abs(2.0 * x / 3.0)
+    approx = jnp.sign(x) * (1.0 - 1.0 / (1.0 + a + a ** 2 + 1.41645 * a ** 4))
+    return 1.7159 * approx
+
+
+@register_activation("rectifiedtanh")
+def rectifiedtanh(x):
+    return jnp.maximum(0.0, jnp.tanh(x))
+
+
+@register_activation("swish")
+def swish(x):
+    return jax.nn.silu(x)
+
+
+@register_activation("gelu")
+def gelu(x):
+    return jax.nn.gelu(x)
+
+
+@register_activation("relu6")
+def relu6(x):
+    return jax.nn.relu6(x)
+
+
+@register_activation("mish")
+def mish(x):
+    return x * jnp.tanh(jax.nn.softplus(x))

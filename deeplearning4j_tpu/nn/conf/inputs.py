@@ -1,0 +1,103 @@
+"""InputType system: drives automatic shape inference (nIn) and automatic
+insertion of input preprocessors between layer families.
+
+Capability parity with reference nn/conf/inputs/InputType.java:60-92 and the
+setInputType plumbing at nn/conf/MultiLayerConfiguration.java:412-421.
+
+TPU-first layout conventions (differ from the reference deliberately):
+- convolutional: NHWC [batch, height, width, channels]  (reference: NCHW)
+- recurrent:     [batch, time, features]                 (reference: [b, size, t])
+NHWC + channel-last is the layout XLA prefers on TPU (MXU tiling of the
+channel dim); time-major-second keeps lax.scan over axis 1 contiguous.
+"""
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+
+class InputType:
+    """Factory for input type descriptors."""
+
+    @staticmethod
+    def feed_forward(size):
+        return FeedForwardInputType(int(size))
+
+    @staticmethod
+    def recurrent(size, timesteps=None):
+        return RecurrentInputType(int(size), None if timesteps is None else int(timesteps))
+
+    @staticmethod
+    def convolutional(height, width, channels):
+        return ConvolutionalInputType(int(height), int(width), int(channels))
+
+    @staticmethod
+    def convolutional_flat(height, width, channels):
+        return ConvolutionalFlatInputType(int(height), int(width), int(channels))
+
+    @staticmethod
+    def from_dict(d):
+        t = d["kind"]
+        if t == "ff":
+            return FeedForwardInputType(d["size"])
+        if t == "recurrent":
+            return RecurrentInputType(d["size"], d.get("timesteps"))
+        if t == "cnn":
+            return ConvolutionalInputType(d["height"], d["width"], d["channels"])
+        if t == "cnn_flat":
+            return ConvolutionalFlatInputType(d["height"], d["width"], d["channels"])
+        raise ValueError(f"Unknown input type kind {t}")
+
+
+@dataclass(frozen=True)
+class FeedForwardInputType:
+    size: int
+    kind: str = "ff"
+
+    def flat_size(self):
+        return self.size
+
+    def to_dict(self):
+        return {"kind": "ff", "size": self.size}
+
+
+@dataclass(frozen=True)
+class RecurrentInputType:
+    size: int
+    timesteps: int | None = None
+    kind: str = "recurrent"
+
+    def flat_size(self):
+        return self.size
+
+    def to_dict(self):
+        return {"kind": "recurrent", "size": self.size, "timesteps": self.timesteps}
+
+
+@dataclass(frozen=True)
+class ConvolutionalInputType:
+    height: int
+    width: int
+    channels: int
+    kind: str = "cnn"
+
+    def flat_size(self):
+        return self.height * self.width * self.channels
+
+    def to_dict(self):
+        return {"kind": "cnn", "height": self.height, "width": self.width,
+                "channels": self.channels}
+
+
+@dataclass(frozen=True)
+class ConvolutionalFlatInputType:
+    height: int
+    width: int
+    channels: int
+    kind: str = "cnn_flat"
+
+    def flat_size(self):
+        return self.height * self.width * self.channels
+
+    def to_dict(self):
+        return {"kind": "cnn_flat", "height": self.height, "width": self.width,
+                "channels": self.channels}

@@ -1,0 +1,248 @@
+"""Input preprocessors: shape adapters inserted between layer families.
+
+Capability parity with reference nn/conf/preprocessor/* (12 classes):
+CnnToFeedForward, CnnToRnn, FeedForwardToCnn, FeedForwardToRnn, RnnToCnn,
+RnnToFeedForward, UnitVariance, ZeroMeanAndUnitVariance, ZeroMean,
+BinomialSampling, Composable.
+
+TPU-first: preprocessors are pure reshape/normalise functions traced into the
+same XLA computation as the layers (free fusion), not separate op dispatches.
+Layouts: CNN activations are NHWC, recurrent activations are [b, t, f].
+In the reference these classes also implement `backprop(epsilon)`; here the
+backward pass falls out of autodiff.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+_REGISTRY: dict = {}
+
+
+def register_preprocessor(cls):
+    _REGISTRY[cls.__name__] = cls
+    return cls
+
+
+def preprocessor_from_dict(d):
+    d = dict(d)
+    cls = _REGISTRY[d.pop("type")]
+    return cls(**d)
+
+
+class BasePreprocessor:
+    def __call__(self, x, mask=None):
+        raise NotImplementedError
+
+    def output_type(self, input_type):
+        raise NotImplementedError
+
+    def feed_forward_mask(self, mask):
+        return mask
+
+    def to_dict(self):
+        d = dict(self.__dict__)
+        d["type"] = type(self).__name__
+        return d
+
+
+@register_preprocessor
+class CnnToFeedForwardPreProcessor(BasePreprocessor):
+    """[b,h,w,c] -> [b, h*w*c] (reference: CnnToFeedForwardPreProcessor)."""
+
+    def __init__(self, height=None, width=None, channels=None):
+        self.height, self.width, self.channels = height, width, channels
+
+    def __call__(self, x, mask=None):
+        return x.reshape(x.shape[0], -1)
+
+    def output_type(self, input_type):
+        from .inputs import InputType
+        return InputType.feed_forward(input_type.flat_size())
+
+
+@register_preprocessor
+class FeedForwardToCnnPreProcessor(BasePreprocessor):
+    """[b, h*w*c] -> [b,h,w,c]."""
+
+    def __init__(self, height, width, channels):
+        self.height, self.width, self.channels = int(height), int(width), int(channels)
+
+    def __call__(self, x, mask=None):
+        if x.ndim == 4:
+            return x
+        return x.reshape(x.shape[0], self.height, self.width, self.channels)
+
+    def output_type(self, input_type):
+        from .inputs import InputType
+        return InputType.convolutional(self.height, self.width, self.channels)
+
+
+@register_preprocessor
+class CnnToRnnPreProcessor(BasePreprocessor):
+    """[b*t,h,w,c] flattened conv activations -> [b,t,h*w*c] sequences.
+    The time dimension comes from the mask, or from an explicit `timesteps`
+    when the pipeline is unmasked."""
+
+    def __init__(self, height, width, channels, timesteps=None):
+        self.height, self.width, self.channels = int(height), int(width), int(channels)
+        self.timesteps = None if timesteps is None else int(timesteps)
+
+    def __call__(self, x, mask=None):
+        if x.ndim == 3:
+            return x
+        b_t = x.shape[0]
+        feat = self.height * self.width * self.channels
+        t = mask.shape[1] if mask is not None else self.timesteps
+        if t is None:
+            raise ValueError(
+                "CnnToRnnPreProcessor cannot recover the time dimension: "
+                "provide a feature mask or construct with timesteps=...")
+        return x.reshape(b_t // t, t, feat)
+
+    def output_type(self, input_type):
+        from .inputs import InputType
+        return InputType.recurrent(self.height * self.width * self.channels)
+
+
+@register_preprocessor
+class RnnToCnnPreProcessor(BasePreprocessor):
+    """[b,t,f] -> [b*t,h,w,c]."""
+
+    def __init__(self, height, width, channels):
+        self.height, self.width, self.channels = int(height), int(width), int(channels)
+
+    def __call__(self, x, mask=None):
+        b, t = x.shape[0], x.shape[1]
+        return x.reshape(b * t, self.height, self.width, self.channels)
+
+    def output_type(self, input_type):
+        from .inputs import InputType
+        return InputType.convolutional(self.height, self.width, self.channels)
+
+
+@register_preprocessor
+class FeedForwardToRnnPreProcessor(BasePreprocessor):
+    """[b*t, f] or [b, f] -> [b, t, f]; with no mask treats input as t=1."""
+
+    def __init__(self):
+        pass
+
+    def __call__(self, x, mask=None):
+        if x.ndim == 3:
+            return x
+        if mask is not None:
+            t = mask.shape[1]
+            return x.reshape(x.shape[0] // t, t, x.shape[-1])
+        return x[:, None, :]
+
+    def output_type(self, input_type):
+        from .inputs import InputType
+        return InputType.recurrent(input_type.flat_size())
+
+
+@register_preprocessor
+class RnnToFeedForwardPreProcessor(BasePreprocessor):
+    """[b,t,f] -> [b*t, f] (time steps become independent rows)."""
+
+    def __init__(self):
+        pass
+
+    def __call__(self, x, mask=None):
+        if x.ndim == 2:
+            return x
+        return x.reshape(-1, x.shape[-1])
+
+    def output_type(self, input_type):
+        from .inputs import InputType
+        return InputType.feed_forward(input_type.flat_size())
+
+    def feed_forward_mask(self, mask):
+        return None if mask is None else mask.reshape(-1)
+
+
+@register_preprocessor
+class UnitVarianceProcessor(BasePreprocessor):
+    def __init__(self):
+        pass
+
+    def __call__(self, x, mask=None):
+        std = jnp.std(x, axis=0, keepdims=True) + 1e-8
+        return x / std
+
+    def output_type(self, input_type):
+        return input_type
+
+
+@register_preprocessor
+class ZeroMeanPrePreProcessor(BasePreprocessor):
+    def __init__(self):
+        pass
+
+    def __call__(self, x, mask=None):
+        return x - jnp.mean(x, axis=0, keepdims=True)
+
+    def output_type(self, input_type):
+        return input_type
+
+
+@register_preprocessor
+class ZeroMeanAndUnitVariancePreProcessor(BasePreprocessor):
+    def __init__(self):
+        pass
+
+    def __call__(self, x, mask=None):
+        mu = jnp.mean(x, axis=0, keepdims=True)
+        std = jnp.std(x, axis=0, keepdims=True) + 1e-8
+        return (x - mu) / std
+
+    def output_type(self, input_type):
+        return input_type
+
+
+@register_preprocessor
+class BinomialSamplingPreProcessor(BasePreprocessor):
+    """Samples Bernoulli(x) — used historically for RBM pretraining pipelines."""
+
+    def __init__(self, seed=0):
+        self.seed = int(seed)
+
+    def __call__(self, x, mask=None):
+        # No rng is threaded through the preprocessor SPI, so derive the key
+        # from the batch content: different batches get different noise (unlike
+        # a fixed PRNGKey(seed), which would freeze the sampling pattern).
+        salt = jax.lax.bitcast_convert_type(jnp.sum(x).astype(jnp.float32), jnp.int32)
+        key = jax.random.fold_in(jax.random.PRNGKey(self.seed), salt)
+        return jax.random.bernoulli(key, jnp.clip(x, 0.0, 1.0)).astype(x.dtype)
+
+    def output_type(self, input_type):
+        return input_type
+
+
+class ComposableInputPreProcessor(BasePreprocessor):
+    """Chains preprocessors (reference: ComposableInputPreProcessor)."""
+
+    def __init__(self, *processors):
+        self.processors = list(processors)
+
+    def __call__(self, x, mask=None):
+        for p in self.processors:
+            x = p(x, mask)
+        return x
+
+    def output_type(self, input_type):
+        for p in self.processors:
+            input_type = p.output_type(input_type)
+        return input_type
+
+    def to_dict(self):
+        return {"type": "ComposableInputPreProcessor",
+                "processors": [p.to_dict() for p in self.processors]}
+
+
+_REGISTRY["ComposableInputPreProcessor"] = ComposableInputPreProcessor
+
+
+def _composable_from_dict(d):
+    procs = [preprocessor_from_dict(p) for p in d["processors"]]
+    return ComposableInputPreProcessor(*procs)

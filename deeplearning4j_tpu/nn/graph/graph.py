@@ -1,0 +1,392 @@
+"""ComputationGraph: arbitrary-DAG model with multi-input/multi-output.
+
+Reference: nn/graph/ComputationGraph.java (2276 LoC; init :267,
+topologicalSortOrder :850, fit :671/:740, calcBackpropGradients :1175,
+rnnTimeStep :1789) and the vertex runtime nn/graph/vertex/GraphVertex.java.
+
+TPU-first: vertices are pure functions evaluated in topological order inside
+one traced computation; forward+backward+updater compile to a single XLA
+program per step, exactly like MultiLayerNetwork.
+"""
+from __future__ import annotations
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+import optax
+
+from ..conf.graph_configuration import (ComputationGraphConfiguration,
+                                        DuplicateToTimeSeriesVertex)
+from ..conf.configuration import BackpropType
+from ..layers.base import create_layer
+from ..layers import feedforward, convolution, recurrent, misc, variational  # noqa: F401
+from ..updaters import apply_gradient_normalization
+from ...optimize.listeners import resolve_listeners
+
+
+class ComputationGraph:
+    def __init__(self, conf: ComputationGraphConfiguration):
+        self.conf = conf
+        self.order = conf.topo_sort()
+        self.layers = {}
+        for name in self.order:
+            spec = conf.vertices[name]
+            if spec.kind == "layer":
+                self.layers[name] = create_layer(spec.layer_conf)
+        self.params = None
+        self.states = None
+        self.opt_state = None
+        self._tx = None
+        self.listeners = []
+        self.iteration_count = 0
+        self.epoch_count = 0
+        self.score_value = float("nan")
+        self._dtype = jnp.dtype(conf.dtype)
+        self._rng = jax.random.PRNGKey(conf.seed)
+        self._jit_cache = {}
+        self._rnn_state = {}
+
+    # ------------------------------------------------------------------ init
+    def init(self, params=None):
+        conf = self.conf
+        rng = jax.random.PRNGKey(conf.seed)
+        self.params, self.states = {}, {}
+        types = {}
+        if conf.input_types:
+            for name, t in zip(conf.network_inputs, conf.input_types):
+                types[name] = t
+        for name in self.order:
+            spec = conf.vertices[name]
+            if spec.kind == "input":
+                continue
+            if spec.kind == "layer":
+                rng, sub = jax.random.split(rng)
+                t = types.get(spec.inputs[0])
+                if t is not None and spec.preprocessor is not None:
+                    t = spec.preprocessor.output_type(t)
+                elif t is not None and t.kind == "cnn_flat":
+                    from ..conf.inputs import InputType
+                    t = InputType.feed_forward(t.flat_size())
+                p, s, out_t = self.layers[name].init(sub, t, self._dtype)
+                self.params[name] = p
+                self.states[name] = s
+                types[name] = out_t
+            else:
+                in_types = [types.get(i) for i in spec.inputs]
+                if all(t is not None for t in in_types):
+                    types[name] = spec.vertex_conf.output_type(in_types)
+        if params is not None:
+            self.params = jax.tree_util.tree_map(jnp.asarray, params)
+        self._build_updater()
+        return self
+
+    def _build_updater(self):
+        transforms, labels = {}, {}
+        for name, p in self.params.items():
+            lc = self.conf.vertices[name].layer_conf
+            transforms[name] = lc.updater.to_optax() if lc.updater is not None else optax.sgd(0.1)
+            labels[name] = jax.tree_util.tree_map(lambda _: name, p)
+        self._tx = optax.multi_transform(transforms, labels)
+        self.opt_state = self._tx.init(self.params)
+
+    # -------------------------------------------------------------- forward
+    def _forward(self, params, states, inputs, *, train, rng, masks=None,
+                 initial_carries=None):
+        """inputs: list of arrays aligned with network_inputs. Returns
+        (activations dict, new_states, out_masks dict, carries)."""
+        conf = self.conf
+        acts, out_masks = {}, {}
+        new_states = dict(states)
+        carries = {}
+        in_masks = masks or [None] * len(conf.network_inputs)
+        timesteps = None
+        for name, x, m in zip(conf.network_inputs, inputs, in_masks):
+            acts[name] = x
+            out_masks[name] = m
+            if hasattr(x, "ndim") and x.ndim == 3:
+                timesteps = x.shape[1]
+        for name in self.order:
+            spec = conf.vertices[name]
+            if spec.kind == "input":
+                continue
+            xs = [acts[i] for i in spec.inputs]
+            ms = [out_masks.get(i) for i in spec.inputs]
+            if spec.kind == "layer":
+                x, m = xs[0], ms[0]
+                if spec.preprocessor is not None:
+                    x = spec.preprocessor(x, m)
+                    m = spec.preprocessor.feed_forward_mask(m) if m is not None else None
+                kwargs = {}
+                if initial_carries is not None and name in initial_carries:
+                    kwargs = {"initial_state": initial_carries[name], "return_state": True}
+                if rng is not None:
+                    rng, sub = jax.random.split(rng)
+                else:
+                    sub = None
+                out = self.layers[name].forward(params[name], states[name], x,
+                                                train=train, rng=sub, mask=m, **kwargs)
+                if len(out) == 4:
+                    y, s, m, fin = out
+                    carries[name] = fin
+                else:
+                    y, s, m = out
+                new_states[name] = s
+                acts[name] = y
+                out_masks[name] = m
+            else:
+                vc = spec.vertex_conf
+                if isinstance(vc, DuplicateToTimeSeriesVertex):
+                    ref = vc.reference_input
+                    t = acts[ref].shape[1] if ref in acts and acts[ref].ndim == 3 else timesteps
+                    acts[name] = vc.apply(xs, ms, timesteps=t)
+                else:
+                    acts[name] = vc.apply(xs, ms)
+                out_masks[name] = vc.output_mask(ms)
+        return acts, new_states, out_masks, carries
+
+    # ---------------------------------------------------------------- loss
+    def _loss(self, params, states, inputs, labels, *, train, rng, masks=None,
+              label_masks=None, initial_carries=None):
+        conf = self.conf
+        # run everything except output layers' score; output layer forward is
+        # replaced by its integrated loss on the features feeding it.
+        acts, new_states, out_masks, carries = self._forward(
+            params, states, inputs, train=train, rng=rng, masks=masks,
+            initial_carries=initial_carries)
+        total = 0.0
+        lm = label_masks or [None] * len(conf.network_outputs)
+        for out_name, y, mlab in zip(conf.network_outputs, labels, lm):
+            spec = conf.vertices[out_name]
+            layer = self.layers[out_name]
+            if not layer.is_output_layer():
+                raise ValueError(f"Network output '{out_name}' is not an output layer")
+            feats = acts[spec.inputs[0]]
+            if spec.preprocessor is not None:
+                feats = spec.preprocessor(feats, out_masks.get(spec.inputs[0]))
+            mask = mlab if mlab is not None else out_masks.get(spec.inputs[0])
+            if isinstance(layer, feedforward.CenterLossOutputLayerModule):
+                total = total + layer.score(params[out_name], feats, y, mask, train,
+                                            rng, state=states[out_name])
+                new_states[out_name] = layer.update_centers(states[out_name], feats, y)
+            else:
+                total = total + layer.score(params[out_name], feats, y, mask, train, rng)
+        total = total + self._reg_score(params)
+        return total, (new_states, carries)
+
+    def _reg_score(self, params):
+        total = 0.0
+        for name, p in params.items():
+            lc = self.conf.vertices[name].layer_conf
+            l1, l2 = lc.l1 or 0.0, lc.l2 or 0.0
+            l1b, l2b = lc.l1_bias or 0.0, lc.l2_bias or 0.0
+            if not (l1 or l2 or l1b or l2b):
+                continue
+            for k, v in p.items():
+                is_w = not (k.endswith("b") or k in ("gamma", "beta", "centers"))
+                if is_w:
+                    if l1:
+                        total = total + l1 * jnp.sum(jnp.abs(v))
+                    if l2:
+                        total = total + 0.5 * l2 * jnp.sum(v ** 2)
+                else:
+                    if l1b:
+                        total = total + l1b * jnp.sum(jnp.abs(v))
+                    if l2b:
+                        total = total + 0.5 * l2b * jnp.sum(v ** 2)
+        return total
+
+    def _normalize_grads(self, grads):
+        out = {}
+        for name, g in grads.items():
+            lc = self.conf.vertices[name].layer_conf
+            if lc.gradient_normalization and g:
+                g = apply_gradient_normalization(g, lc.gradient_normalization,
+                                                 lc.gradient_normalization_threshold or 1.0)
+            out[name] = g
+        return out
+
+    # ---------------------------------------------------------------- train
+    def _make_train_step(self):
+        tx = self._tx
+
+        def train_step(params, opt_state, states, rng, inputs, labels, masks,
+                       label_masks):
+            def loss_fn(p):
+                return self._loss(p, states, inputs, labels, train=True, rng=rng,
+                                  masks=masks, label_masks=label_masks)
+            (score, (new_states, _)), grads = jax.value_and_grad(
+                loss_fn, has_aux=True)(params)
+            grads = self._normalize_grads(grads)
+            updates, opt_state = tx.update(grads, opt_state, params)
+            params = optax.apply_updates(params, updates)
+            return params, opt_state, new_states, score
+
+        return jax.jit(train_step, donate_argnums=(0, 1, 2))
+
+    def fit(self, data, labels=None, epochs=1):
+        """Accepts MultiDataSet / DataSet / iterator thereof / (x, y)
+        (reference: fit(DataSetIterator) :671, fit(MultiDataSet) :740)."""
+        from ...datasets.dataset import DataSet, MultiDataSet
+        from ...datasets.iterator.base import as_iterator, DataSetIterator
+        if labels is not None:
+            data = MultiDataSet(data, labels)
+        if isinstance(data, (DataSet, MultiDataSet)):
+            items = [data]
+        elif isinstance(data, DataSetIterator):
+            items = data
+        elif isinstance(data, (list, tuple)):
+            items = list(data)
+        else:
+            items = as_iterator(data)
+        for _ in range(epochs):
+            if hasattr(items, "reset"):
+                items.reset()
+            for ds in items:
+                self.fit_batch(ds)
+            self.epoch_count += 1
+        return self
+
+    def fit_batch(self, ds):
+        from ...datasets.dataset import DataSet, MultiDataSet
+        if self.params is None:
+            self.init()
+        if isinstance(ds, DataSet):
+            ds = MultiDataSet([ds.features], [ds.labels],
+                              None if ds.features_mask is None else [ds.features_mask],
+                              None if ds.labels_mask is None else [ds.labels_mask])
+        inputs = [jnp.asarray(f) for f in ds.features]
+        labels = [jnp.asarray(l, self._dtype) for l in ds.labels]
+        masks = None if ds.features_masks is None else \
+            [None if m is None else jnp.asarray(m, self._dtype) for m in ds.features_masks]
+        lmasks = None if ds.labels_masks is None else \
+            [None if m is None else jnp.asarray(m, self._dtype) for m in ds.labels_masks]
+        self._rng, step_rng = jax.random.split(self._rng)
+        key = ("train", masks is None, lmasks is None)
+        if key not in self._jit_cache:
+            self._jit_cache[key] = self._make_train_step()
+        step = self._jit_cache[key]
+        self.params, self.opt_state, self.states, score = step(
+            self.params, self.opt_state, self.states, step_rng, inputs, labels,
+            masks, lmasks)
+        self.score_value = float(score)
+        self.iteration_count += 1
+        for listener in self.listeners:
+            listener.iteration_done(self, self.iteration_count)
+
+    # ------------------------------------------------------------ inference
+    def output(self, *inputs, train=False):
+        """(reference: ComputationGraph.output / outputSingle)"""
+        if self.params is None:
+            self.init()
+        inputs = [jnp.asarray(x) for x in inputs]
+        key = ("output", len(inputs))
+        if key not in self._jit_cache:
+            def fwd(params, states, xs):
+                acts, _, _, _ = self._forward(params, states, xs, train=False, rng=None)
+                return [acts[o] for o in self.conf.network_outputs]
+            self._jit_cache[key] = jax.jit(fwd)
+        outs = self._jit_cache[key](self.params, self.states, inputs)
+        return outs[0] if len(outs) == 1 else outs
+
+    def feed_forward(self, *inputs, train=False):
+        acts, _, _, _ = self._forward(self.params, self.states,
+                                      [jnp.asarray(x) for x in inputs],
+                                      train=train, rng=None)
+        return acts
+
+    def score(self, ds):
+        from ...datasets.dataset import DataSet, MultiDataSet
+        if isinstance(ds, DataSet):
+            ds = MultiDataSet([ds.features], [ds.labels])
+        inputs = [jnp.asarray(f) for f in ds.features]
+        labels = [jnp.asarray(l, self._dtype) for l in ds.labels]
+        s, _ = self._loss(self.params, self.states, inputs, labels, train=False,
+                          rng=None)
+        return float(s)
+
+    def compute_gradient_and_score(self, inputs, labels, masks=None, label_masks=None):
+        inputs = [jnp.asarray(x) for x in (inputs if isinstance(inputs, (list, tuple)) else [inputs])]
+        labels = [jnp.asarray(y) for y in (labels if isinstance(labels, (list, tuple)) else [labels])]
+
+        def loss_fn(p):
+            s, _ = self._loss(p, self.states, inputs, labels, train=False, rng=None,
+                              masks=masks, label_masks=label_masks)
+            return s
+        score, grads = jax.value_and_grad(loss_fn)(self.params)
+        return grads, float(score)
+
+    # ------------------------------------------------------- rnn streaming
+    def rnn_time_step(self, *inputs):
+        """(reference: rnnTimeStep :1789)"""
+        inputs = [jnp.asarray(x) for x in inputs]
+        squeeze = inputs[0].ndim == 2
+        if squeeze:
+            inputs = [x[:, None, :] if x.ndim == 2 else x for x in inputs]
+        batch = inputs[0].shape[0]
+        carries = self._rnn_state or self._zero_carries(batch)
+        acts, _, _, new_carries = self._forward(self.params, self.states, inputs,
+                                                train=False, rng=None,
+                                                initial_carries=carries)
+        self._rnn_state = new_carries
+        outs = [acts[o] for o in self.conf.network_outputs]
+        if squeeze:
+            outs = [o[:, -1] if o.ndim == 3 else o for o in outs]
+        return outs[0] if len(outs) == 1 else outs
+
+    def rnn_clear_previous_state(self):
+        self._rnn_state = {}
+
+    def _zero_carries(self, batch):
+        carries = {}
+        for name, layer in self.layers.items():
+            if hasattr(layer, "init_carry"):
+                carries[name] = layer.init_carry(batch, self._dtype)
+        return carries
+
+    # -------------------------------------------------------------- params
+    def param_table(self):
+        out = {}
+        for name, p in self.params.items():
+            for k, v in p.items():
+                out[f"{name}_{k}"] = v
+        return out
+
+    def num_params(self):
+        return sum(int(x.size) for x in jax.tree_util.tree_leaves(self.params))
+
+    def get_flat_params(self):
+        leaves = []
+        for name in sorted(self.params.keys()):
+            p = self.params[name]
+            for k in sorted(p.keys()):
+                leaves.append(np.asarray(p[k]).ravel())
+        if not leaves:
+            return np.zeros((0,), np.float32)
+        return np.concatenate(leaves)
+
+    def set_flat_params(self, flat):
+        flat = np.asarray(flat)
+        off = 0
+        for name in sorted(self.params.keys()):
+            p = self.params[name]
+            for k in sorted(p.keys()):
+                n = int(np.prod(p[k].shape)) if p[k].shape else 1
+                p[k] = jnp.asarray(flat[off:off + n].reshape(p[k].shape), p[k].dtype)
+                off += n
+        return self
+
+    def set_listeners(self, *listeners):
+        self.listeners = resolve_listeners(listeners)
+        return self
+
+    def evaluate(self, iterator):
+        from ...eval.evaluation import Evaluation
+        from ...datasets.iterator.base import as_iterator
+        e = Evaluation()
+        it = as_iterator(iterator)
+        it.reset()
+        for ds in it:
+            out = self.output(ds.features)
+            e.eval(np.asarray(ds.labels), np.asarray(out),
+                   None if ds.labels_mask is None else np.asarray(ds.labels_mask))
+        return e

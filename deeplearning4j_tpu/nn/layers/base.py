@@ -1,0 +1,78 @@
+"""Runtime layer SPI.
+
+Mirrors the reference's Layer contract (nn/api/Layer.java:37 — activate :202,
+backpropGradient :119, feedForwardMaskArray :309) with a TPU-first twist:
+layers are pure functions of (params, state, input); the backward pass is
+derived by JAX autodiff instead of hand-written backpropGradient, and the whole
+network's forward+backward+update traces into a single XLA computation.
+
+A custom layer can still provide its own gradient by wrapping its forward in
+jax.custom_vjp — that is the analog of the reference's hand-written layers.
+
+State = non-trainable per-layer variables (e.g. batch-norm running stats,
+center-loss centers). Mask = per-timestep validity [batch, time] for
+variable-length sequences (reference: Layer.feedForwardMaskArray).
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ..activations import get_activation
+
+LAYER_IMPL_REGISTRY: dict = {}
+
+
+def register_impl(conf_cls_name):
+    def deco(cls):
+        LAYER_IMPL_REGISTRY[conf_cls_name] = cls
+        return cls
+    return deco
+
+
+def create_layer(conf):
+    cls = LAYER_IMPL_REGISTRY.get(type(conf).__name__)
+    if cls is None:
+        raise ValueError(f"No runtime implementation for layer config {type(conf).__name__}")
+    return cls(conf)
+
+
+def apply_dropout(x, rate, train, rng):
+    """Inverted dropout on the layer *input*, matching the reference
+    (nn/conf dropout semantics, util/Dropout.java: applied to input at train time)."""
+    if not train or rate is None or rate <= 0.0 or rng is None:
+        return x
+    keep = 1.0 - rate
+    mask = jax.random.bernoulli(rng, keep, x.shape)
+    return jnp.where(mask, x / keep, 0.0)
+
+
+class BaseLayerModule:
+    """One instantiated layer: shape-aware param init + pure forward."""
+
+    def __init__(self, conf):
+        self.conf = conf
+
+    # -- init ---------------------------------------------------------------
+    def init(self, rng, input_type, dtype=jnp.float32):
+        """Returns (params: dict, state: dict, output_type)."""
+        raise NotImplementedError
+
+    # -- forward ------------------------------------------------------------
+    def forward(self, params, state, x, *, train=False, rng=None, mask=None):
+        """Returns (activations, new_state, out_mask)."""
+        raise NotImplementedError
+
+    # -- optional: output-layer protocol -------------------------------------
+    def is_output_layer(self):
+        return False
+
+    # -- optional: pretrainable protocol (AE/RBM/VAE) -------------------------
+    def is_pretrainable(self):
+        return False
+
+    def activation_fn(self):
+        return get_activation(self.conf.activation or "identity")
+
+    def num_params(self, params):
+        return sum(int(p.size) for p in jax.tree_util.tree_leaves(params))

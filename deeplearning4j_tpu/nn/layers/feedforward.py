@@ -1,0 +1,255 @@
+"""Dense / Embedding / output-layer family / AutoEncoder / RBM runtime.
+
+Reference counterparts: nn/layers/feedforward/dense/DenseLayer.java,
+feedforward/embedding/EmbeddingLayer.java, BaseOutputLayer.java, LossLayer.java,
+training/CenterLossOutputLayer.java, feedforward/autoencoder/AutoEncoder.java,
+feedforward/rbm/RBM.java.
+
+Param keys follow the reference's DefaultParamInitializer ("W", "b") so the
+flattened-view checkpoint layout is recognizable.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from .base import BaseLayerModule, register_impl, apply_dropout
+from ..activations import get_activation
+from ..losses import get_loss
+from ..weights import init_weights
+from ..conf.inputs import InputType
+
+
+class _DenseCore(BaseLayerModule):
+    def init(self, rng, input_type, dtype=jnp.float32):
+        c = self.conf
+        n_in, n_out = int(c.n_in), int(c.n_out)
+        k1, _ = jax.random.split(rng)
+        # Kernel stored [n_in, n_out]: row-major activations @ W hits the MXU
+        # directly (the reference stores [n_out, n_in] and transposes in gemm).
+        params = {
+            "W": init_weights(k1, (n_in, n_out), c.weight_init, fan_in=n_in,
+                              fan_out=n_out, distribution=c.dist, dtype=dtype),
+            "b": jnp.full((n_out,), c.bias_init or 0.0, dtype),
+        }
+        return params, {}, InputType.feed_forward(n_out)
+
+    def preoutput(self, params, x):
+        if x.ndim > 2:
+            x = x.reshape(x.shape[0], -1)
+        return x @ params["W"] + params["b"]
+
+    def forward(self, params, state, x, *, train=False, rng=None, mask=None):
+        x = apply_dropout(x, self.conf.dropout, train, rng)
+        z = self.preoutput(params, x)
+        return self.activation_fn()(z), state, mask
+
+
+@register_impl("DenseLayer")
+class DenseLayerModule(_DenseCore):
+    pass
+
+
+@register_impl("EmbeddingLayer")
+class EmbeddingLayerModule(BaseLayerModule):
+    """Index lookup: mathematically a one-hot matmul, implemented as a gather
+    (reference: feedforward/embedding/EmbeddingLayer.java)."""
+
+    def init(self, rng, input_type, dtype=jnp.float32):
+        c = self.conf
+        params = {"W": init_weights(rng, (int(c.n_in), int(c.n_out)), c.weight_init,
+                                    fan_in=c.n_in, fan_out=c.n_out,
+                                    distribution=c.dist, dtype=dtype)}
+        if getattr(c, "has_bias", True):
+            params["b"] = jnp.full((int(c.n_out),), c.bias_init or 0.0, dtype)
+        return params, {}, InputType.feed_forward(int(c.n_out))
+
+    def forward(self, params, state, x, *, train=False, rng=None, mask=None):
+        if x.ndim >= 2 and x.shape[-1] == int(self.conf.n_in) and x.shape[-1] > 1:
+            idx = jnp.argmax(x, axis=-1)  # one-hot input accepted like reference
+        else:
+            idx = x.reshape(x.shape[0]).astype(jnp.int32)
+        out = params["W"][idx]
+        if "b" in params:
+            out = out + params["b"]
+        return self.activation_fn()(out), state, mask
+
+
+class BaseOutputLayerModule(_DenseCore):
+    """Dense + integrated loss (reference: BaseOutputLayer.java)."""
+
+    def is_output_layer(self):
+        return True
+
+    def loss_fn(self):
+        return get_loss(self.conf.loss)
+
+    def score(self, params, x, labels, mask=None, train=False, rng=None):
+        x = apply_dropout(x, self.conf.dropout, train, rng)
+        z = self.preoutput(params, x)
+        return self.loss_fn()(labels, z, self.conf.activation, mask)
+
+
+@register_impl("OutputLayer")
+class OutputLayerModule(BaseOutputLayerModule):
+    pass
+
+
+@register_impl("RnnOutputLayer")
+class RnnOutputLayerModule(BaseOutputLayerModule):
+    """Applies the dense projection per timestep on [b,t,f]
+    (reference: nn/layers/recurrent/RnnOutputLayer.java)."""
+
+    def preoutput(self, params, x):
+        return x @ params["W"] + params["b"]
+
+    def forward(self, params, state, x, *, train=False, rng=None, mask=None):
+        z = self.preoutput(params, x)
+        return self.activation_fn()(z), state, mask
+
+    def score(self, params, x, labels, mask=None, train=False, rng=None):
+        z = self.preoutput(params, x)
+        b, t = z.shape[0], z.shape[1]
+        z2 = z.reshape(b * t, -1)
+        lab2 = labels.reshape(b * t, -1)
+        m2 = mask.reshape(b * t) if mask is not None else None
+        return self.loss_fn()(lab2, z2, self.conf.activation, m2)
+
+
+@register_impl("LossLayer")
+class LossLayerModule(BaseLayerModule):
+    """Parameterless loss on incoming activations (reference: LossLayer.java)."""
+
+    def init(self, rng, input_type, dtype=jnp.float32):
+        return {}, {}, input_type
+
+    def forward(self, params, state, x, *, train=False, rng=None, mask=None):
+        return self.activation_fn()(x), state, mask
+
+    def is_output_layer(self):
+        return True
+
+    def score(self, params, x, labels, mask=None, train=False, rng=None):
+        return get_loss(self.conf.loss)(labels, x, self.conf.activation, mask)
+
+
+@register_impl("CenterLossOutputLayer")
+class CenterLossOutputLayerModule(BaseOutputLayerModule):
+    """Softmax output + center loss (reference:
+    nn/layers/training/CenterLossOutputLayer.java). Class centers live in
+    layer state, updated by exponential moving average toward the masked
+    feature means (the reference's alpha update), not by the optimizer."""
+
+    def init(self, rng, input_type, dtype=jnp.float32):
+        params, state, out = super().init(rng, input_type, dtype)
+        state = dict(state)
+        state["centers"] = jnp.zeros((int(self.conf.n_out), int(self.conf.n_in)), dtype)
+        return params, state, out
+
+    def score(self, params, x, labels, mask=None, train=False, rng=None, state=None):
+        base = super().score(params, x, labels, mask, train, rng)
+        centers = state["centers"] if state is not None else jnp.zeros(
+            (int(self.conf.n_out), int(self.conf.n_in)), x.dtype)
+        assigned = labels @ centers  # [b, n_in] center of each example's class
+        center_l = 0.5 * jnp.mean(jnp.sum((x - assigned) ** 2, axis=-1))
+        return base + self.conf.lambda_ * center_l
+
+    def update_centers(self, state, x, labels):
+        """EMA center update (alpha), called from the train step with
+        stop_gradient'd features."""
+        centers = state["centers"]
+        counts = jnp.sum(labels, axis=0)[:, None] + 1.0
+        sums = labels.T @ jax.lax.stop_gradient(x)
+        delta = (centers * jnp.sum(labels, axis=0)[:, None] - sums) / counts
+        new_centers = centers - self.conf.alpha * delta
+        out = dict(state)
+        out["centers"] = new_centers
+        return out
+
+
+@register_impl("AutoEncoder")
+class AutoEncoderModule(_DenseCore):
+    """Denoising autoencoder (reference: feedforward/autoencoder/AutoEncoder.java).
+    Supervised forward = encoder; pretrain loss = reconstruction of corrupted
+    input through tied-ish decoder (separate visible bias, shared W^T)."""
+
+    def init(self, rng, input_type, dtype=jnp.float32):
+        params, state, out = super().init(rng, input_type, dtype)
+        params["vb"] = jnp.zeros((int(self.conf.n_in),), dtype)
+        return params, state, out
+
+    def is_pretrainable(self):
+        return True
+
+    def pretrain_loss(self, params, x, rng):
+        c = self.conf
+        if x.ndim > 2:
+            x = x.reshape(x.shape[0], -1)
+        corrupted = x
+        if c.corruption_level and c.corruption_level > 0 and rng is not None:
+            keep = jax.random.bernoulli(rng, 1.0 - c.corruption_level, x.shape)
+            corrupted = jnp.where(keep, x, 0.0)
+        h = self.activation_fn()(corrupted @ params["W"] + params["b"])
+        recon_pre = h @ params["W"].T + params["vb"]
+        loss = get_loss(c.loss)(x, recon_pre, c.activation, None)
+        if c.sparsity and c.sparsity > 0:
+            loss = loss + c.sparsity * jnp.mean(jnp.abs(h))
+        return loss
+
+
+@register_impl("RBM")
+class RBMModule(_DenseCore):
+    """Restricted Boltzmann machine with CD-k pretraining (reference:
+    feedforward/rbm/RBM.java). Supervised forward = propup probabilities."""
+
+    def init(self, rng, input_type, dtype=jnp.float32):
+        params, state, out = super().init(rng, input_type, dtype)
+        params["vb"] = jnp.zeros((int(self.conf.n_in),), dtype)
+        return params, state, out
+
+    def is_pretrainable(self):
+        return True
+
+    def _propup(self, params, v):
+        pre = v @ params["W"] + params["b"]
+        hu = self.conf.hidden_unit
+        if hu == "binary" or hu == "softmax":
+            return jax.nn.sigmoid(pre) if hu == "binary" else jax.nn.softmax(pre)
+        if hu == "rectified":
+            return jax.nn.relu(pre)
+        return pre  # gaussian
+
+    def _propdown(self, params, h):
+        pre = h @ params["W"].T + params["vb"]
+        if self.conf.visible_unit == "binary":
+            return jax.nn.sigmoid(pre)
+        return pre  # gaussian
+
+    def pretrain_loss(self, params, x, rng):
+        """CD-k free-energy-difference surrogate: autodiff of
+        FE(data) - FE(model sample) reproduces the CD gradient; the Gibbs
+        chain itself is stop-gradient'd (the TPU-friendly formulation — the
+        reference hand-codes the W/vb/hb gradient from the chain ends)."""
+        c = self.conf
+        if x.ndim > 2:
+            x = x.reshape(x.shape[0], -1)
+        v0 = x
+        vk = v0
+        key = rng if rng is not None else jax.random.PRNGKey(0)
+        for _ in range(max(1, int(c.k))):
+            key, k1, k2 = jax.random.split(key, 3)
+            ph = self._propup(params, vk)
+            h = jax.random.bernoulli(k1, jnp.clip(ph, 0, 1)).astype(x.dtype) \
+                if c.hidden_unit == "binary" else ph
+            pv = self._propdown(params, h)
+            vk = jax.random.bernoulli(k2, jnp.clip(pv, 0, 1)).astype(x.dtype) \
+                if c.visible_unit == "binary" else pv
+        vk = jax.lax.stop_gradient(vk)
+
+        def free_energy(v):
+            wx_b = v @ params["W"] + params["b"]
+            vbias_term = v @ params["vb"]
+            hidden_term = jnp.sum(jax.nn.softplus(wx_b), axis=-1)
+            return -hidden_term - vbias_term
+
+        return jnp.mean(free_energy(v0) - free_energy(vk))

@@ -1,0 +1,476 @@
+"""MultiLayerNetwork: the sequential-stack model and #1 user entry point.
+
+Reference: nn/multilayer/MultiLayerNetwork.java (2444 LoC; init :385,
+fit(DataSetIterator) :902, computeGradientAndScore :1729, backprop :973,
+output :1462, feedForwardToLayer :692, pretrain :164, doTruncatedBPTT :1064,
+rnnTimeStep ~:2100, score(DataSet) :1629).
+
+TPU-first redesign: instead of a Java per-layer interpreter loop calling
+hand-written backpropGradient per layer, the ENTIRE minibatch step —
+forward, loss, backward (autodiff), gradient normalization, updater
+(optax: LR schedule + momentum/adam state), parameter update, batch-norm
+running-stat update — traces into ONE jit-compiled XLA computation with donated
+parameter/optimizer buffers (the functional analog of the reference's in-place
+flattened param view, Model.setParamsViewArray nn/api/Model.java:123).
+"""
+from __future__ import annotations
+
+import functools
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+import optax
+
+from ..conf.configuration import MultiLayerConfiguration, BackpropType
+from ..layers.base import create_layer
+from ..layers import feedforward, convolution, recurrent, misc, variational  # noqa: F401 (register impls)
+from ..updaters import apply_gradient_normalization
+from ...optimize.listeners import resolve_listeners
+
+
+def _is_weight_key(k):
+    return not (k.endswith("b") or k in ("gamma", "beta", "centers", "mean", "var"))
+
+
+class MultiLayerNetwork:
+    def __init__(self, conf: MultiLayerConfiguration):
+        self.conf = conf
+        self.layers = [create_layer(lc) for lc in conf.layers]
+        self.params = None          # {"0": {...}, "1": {...}}
+        self.states = None          # non-trainable per-layer state
+        self.opt_state = None
+        self._tx = None
+        self.listeners = []
+        self.iteration_count = 0
+        self.epoch_count = 0
+        self.score_value = float("nan")
+        self._dtype = jnp.dtype(conf.dtype)
+        self._rng = jax.random.PRNGKey(conf.seed)
+        self._rnn_state = {}        # streaming inference carries per layer idx
+        self._jit_cache = {}
+
+    # ------------------------------------------------------------------ init
+    def init(self, params=None):
+        """Initialize parameters (reference: MultiLayerNetwork.init :385)."""
+        conf = self.conf
+        rng = jax.random.PRNGKey(conf.seed)
+        self.params, self.states = {}, {}
+        cur_type = conf.input_type
+        for i, layer in enumerate(self.layers):
+            rng, sub = jax.random.split(rng)
+            pre = conf.input_preprocessors.get(i)
+            if cur_type is not None and pre is not None:
+                cur_type = pre.output_type(cur_type)
+            elif cur_type is not None and cur_type.kind == "cnn_flat":
+                from ..conf.inputs import InputType
+                cur_type = InputType.feed_forward(cur_type.flat_size())
+            p, s, out_type = layer.init(sub, cur_type, self._dtype)
+            self.params[str(i)] = p
+            self.states[str(i)] = s
+            cur_type = out_type
+        if params is not None:
+            self.set_params(params)
+        self._build_updater()
+        return self
+
+    def _build_updater(self):
+        """Per-layer optax transforms (each layer may override the updater —
+        reference: LayerUpdater per layer, UpdaterCreator)."""
+        transforms, labels = {}, {}
+        for i, lc in enumerate(self.conf.layers):
+            transforms[str(i)] = lc.updater.to_optax() if lc.updater is not None else optax.sgd(0.1)
+            labels[str(i)] = jax.tree_util.tree_map(lambda _: str(i), self.params[str(i)])
+        self._tx = optax.multi_transform(transforms, labels)
+        self.opt_state = self._tx.init(self.params)
+
+    # -------------------------------------------------------------- forward
+    def _apply_preprocessor(self, i, x, mask):
+        pre = self.conf.input_preprocessors.get(i)
+        if pre is not None:
+            x = pre(x, mask)
+            mask = pre.feed_forward_mask(mask) if mask is not None else None
+        return x, mask
+
+    def _forward(self, params, states, x, *, train, rng, mask=None, to_layer=None,
+                 initial_carries=None, collect=False):
+        """Run layers [0, to_layer); returns (activations, new_states, mask,
+        final_carries, collected)."""
+        n = len(self.layers) if to_layer is None else to_layer
+        new_states = dict(states)
+        carries = {}
+        collected = []
+        cur_mask = mask
+        for i in range(n):
+            layer = self.layers[i]
+            x, cur_mask = self._apply_preprocessor(i, x, cur_mask)
+            kwargs = {}
+            if initial_carries is not None and str(i) in initial_carries:
+                kwargs = {"initial_state": initial_carries[str(i)], "return_state": True}
+            if rng is not None:
+                rng, sub = jax.random.split(rng)
+            else:
+                sub = None
+            out = layer.forward(params[str(i)], states[str(i)], x, train=train,
+                                rng=sub, mask=cur_mask, **kwargs)
+            if len(out) == 4:
+                x, new_s, cur_mask, final = out
+                carries[str(i)] = final
+            else:
+                x, new_s, cur_mask = out
+            new_states[str(i)] = new_s
+            if collect:
+                collected.append(x)
+        return x, new_states, cur_mask, carries, collected
+
+    # ------------------------------------------------------------- loss/score
+    def _loss(self, params, states, x, y, *, train, rng, mask=None, label_mask=None,
+              initial_carries=None):
+        out_idx = len(self.layers) - 1
+        feats, new_states, cur_mask, carries, _ = self._forward(
+            params, states, x, train=train, rng=rng, mask=mask, to_layer=out_idx,
+            initial_carries=initial_carries)
+        out_layer = self.layers[out_idx]
+        feats, cur_mask = self._apply_preprocessor(out_idx, feats, cur_mask)
+        if not out_layer.is_output_layer():
+            raise ValueError("Last layer is not an output/loss layer")
+        lm = label_mask if label_mask is not None else cur_mask
+        if isinstance(out_layer, feedforward.CenterLossOutputLayerModule):
+            score = out_layer.score(params[str(out_idx)], feats, y, lm, train, rng,
+                                    state=states[str(out_idx)])
+            new_states[str(out_idx)] = out_layer.update_centers(states[str(out_idx)], feats, y)
+        else:
+            score = out_layer.score(params[str(out_idx)], feats, y, lm, train, rng)
+        score = score + self._reg_score(params)
+        return score, (new_states, carries)
+
+    def _reg_score(self, params):
+        """L1/L2 terms (reference: BaseLayer.calcL1/calcL2 added into score)."""
+        total = 0.0
+        for i, lc in enumerate(self.conf.layers):
+            l1 = lc.l1 or 0.0
+            l2 = lc.l2 or 0.0
+            l1b = lc.l1_bias or 0.0
+            l2b = lc.l2_bias or 0.0
+            if l1 == 0 and l2 == 0 and l1b == 0 and l2b == 0:
+                continue
+            for k, p in params[str(i)].items():
+                if _is_weight_key(k):
+                    if l1:
+                        total = total + l1 * jnp.sum(jnp.abs(p))
+                    if l2:
+                        total = total + 0.5 * l2 * jnp.sum(p ** 2)
+                else:
+                    if l1b:
+                        total = total + l1b * jnp.sum(jnp.abs(p))
+                    if l2b:
+                        total = total + 0.5 * l2b * jnp.sum(p ** 2)
+        return total
+
+    def _normalize_grads(self, grads):
+        out = {}
+        for i, lc in enumerate(self.conf.layers):
+            g = grads[str(i)]
+            if lc.gradient_normalization and g:
+                g = apply_gradient_normalization(
+                    g, lc.gradient_normalization,
+                    lc.gradient_normalization_threshold or 1.0)
+            out[str(i)] = g
+        return out
+
+    # ---------------------------------------------------------------- train
+    def _make_train_step(self, tbptt=False):
+        tx = self._tx
+
+        def train_step(params, opt_state, states, rng, x, y, mask, label_mask, carries):
+            def loss_fn(p):
+                return self._loss(p, states, x, y, train=True, rng=rng, mask=mask,
+                                  label_mask=label_mask,
+                                  initial_carries=carries if tbptt else None)
+            (score, (new_states, out_carries)), grads = jax.value_and_grad(
+                loss_fn, has_aux=True)(params)
+            grads = self._normalize_grads(grads)
+            updates, opt_state = tx.update(grads, opt_state, params)
+            params = optax.apply_updates(params, updates)
+            return params, opt_state, new_states, score, out_carries
+
+        return jax.jit(train_step, donate_argnums=(0, 1, 2))
+
+    def _get_train_step(self, key):
+        if key not in self._jit_cache:
+            self._jit_cache[key] = self._make_train_step(tbptt="tbptt" in key)
+        return self._jit_cache[key]
+
+    def fit(self, data, labels=None, epochs=1):
+        """Train. `data` may be a DataSetIterator-like, a DataSet, or (x, y)
+        arrays (reference: fit(DataSetIterator) :902 and fit(INDArray,INDArray))."""
+        from ...datasets.dataset import DataSet
+        from ...datasets.iterator.base import as_iterator
+        if labels is not None:
+            data = DataSet(data, labels)
+        it = as_iterator(data)
+        for _ in range(epochs):
+            for listener in self.listeners:
+                listener.on_epoch_start(self)
+            it.reset()
+            for ds in it:
+                self.fit_batch(ds)
+            for listener in self.listeners:
+                listener.on_epoch_end(self)
+            self.epoch_count += 1
+        return self
+
+    def fit_batch(self, ds):
+        """One minibatch step — one XLA computation on device."""
+        if self.params is None:
+            self.init()
+        x = jnp.asarray(ds.features, self._dtype) \
+            if not str(ds.features.dtype).startswith("int") else jnp.asarray(ds.features)
+        y = jnp.asarray(ds.labels, self._dtype)
+        mask = None if ds.features_mask is None else jnp.asarray(ds.features_mask, self._dtype)
+        lmask = None if ds.labels_mask is None else jnp.asarray(ds.labels_mask, self._dtype)
+        self._rng, step_rng = jax.random.split(self._rng)
+
+        if (self.conf.backprop_type == BackpropType.TRUNCATED_BPTT and x.ndim == 3
+                and x.shape[1] > self.conf.tbptt_fwd_length):
+            self._fit_tbptt(x, y, mask, lmask, step_rng)
+        else:
+            step = self._get_train_step("std")
+            self.params, self.opt_state, self.states, score, _ = step(
+                self.params, self.opt_state, self.states, step_rng, x, y, mask,
+                lmask, None)
+            self.score_value = float(score)
+        self.iteration_count += 1
+        for listener in self.listeners:
+            listener.iteration_done(self, self.iteration_count)
+
+    def _fit_tbptt(self, x, y, mask, lmask, rng):
+        """Truncated BPTT (reference: doTruncatedBPTT :1064): slide a window of
+        tbptt_fwd_length over time, carrying recurrent state (stop-gradient)
+        across windows."""
+        T = x.shape[1]
+        L = self.conf.tbptt_fwd_length
+        carries = self._zero_carries(x.shape[0], x.dtype)
+        step = self._get_train_step("tbptt")
+        scores = []
+        for start in range(0, T, L):
+            end = min(start + L, T)
+            xw = x[:, start:end]
+            yw = y[:, start:end] if y.ndim == 3 else y
+            mw = mask[:, start:end] if mask is not None else None
+            lmw = lmask[:, start:end] if lmask is not None else None
+            rng, sub = jax.random.split(rng)
+            self.params, self.opt_state, self.states, score, carries = step(
+                self.params, self.opt_state, self.states, sub, xw, yw, mw, lmw, carries)
+            carries = jax.tree_util.tree_map(jax.lax.stop_gradient, carries)
+            scores.append(float(score))
+        self.score_value = float(np.mean(scores))
+
+    def _zero_carries(self, batch, dtype):
+        carries = {}
+        for i, layer in enumerate(self.layers):
+            if hasattr(layer, "init_carry"):
+                carries[str(i)] = layer.init_carry(batch, dtype)
+        return carries
+
+    # ------------------------------------------------------------ inference
+    def output(self, x, train=False):
+        """Full forward pass (reference: output :1462). Jitted per input shape.
+        train=True uses train-mode semantics (batch statistics for BN); dropout
+        stays off because no rng is threaded through inference."""
+        if self.params is None:
+            self.init()
+        x = jnp.asarray(x)
+        key = ("output", bool(train))
+        if key not in self._jit_cache:
+            is_train = bool(train)
+
+            def fwd(params, states, xx):
+                out, _, _, _, _ = self._forward(params, states, xx, train=is_train,
+                                                rng=None)
+                return out
+            self._jit_cache[key] = jax.jit(fwd)
+        return self._jit_cache[key](self.params, self.states, x)
+
+    def feed_forward(self, x, train=False):
+        """Per-layer activations list (reference: feedForward)."""
+        x = jnp.asarray(x)
+        _, _, _, _, acts = self._forward(self.params, self.states, x, train=train,
+                                         rng=None, collect=True)
+        return acts
+
+    def feed_forward_to_layer(self, layer_idx, x, train=False):
+        """(reference: feedForwardToLayer :692) — activations up to and
+        including layer_idx."""
+        x = jnp.asarray(x)
+        out, _, _, _, _ = self._forward(self.params, self.states, x, train=train,
+                                        rng=None, to_layer=layer_idx + 1)
+        return out
+
+    def score(self, ds_or_x, labels=None, train=False):
+        """Mean loss on data (reference: score(DataSet) :1629)."""
+        if labels is not None:
+            x, y, mask, lmask = ds_or_x, labels, None, None
+        else:
+            x, y = ds_or_x.features, ds_or_x.labels
+            mask = ds_or_x.features_mask
+            lmask = ds_or_x.labels_mask
+        s, _ = self._loss(self.params, self.states, jnp.asarray(x), jnp.asarray(y),
+                          train=train, rng=None,
+                          mask=None if mask is None else jnp.asarray(mask),
+                          label_mask=None if lmask is None else jnp.asarray(lmask))
+        return float(s)
+
+    def compute_gradient_and_score(self, x, y, mask=None, label_mask=None):
+        """(reference: computeGradientAndScore :1729) — used by gradient checks."""
+        def loss_fn(p):
+            s, _ = self._loss(p, self.states, jnp.asarray(x), jnp.asarray(y),
+                              train=False, rng=None,
+                              mask=None if mask is None else jnp.asarray(mask),
+                              label_mask=None if label_mask is None else jnp.asarray(label_mask))
+            return s
+        score, grads = jax.value_and_grad(loss_fn)(self.params)
+        return grads, float(score)
+
+    # ------------------------------------------------------- rnn streaming
+    def rnn_time_step(self, x):
+        """Stateful streaming inference (reference: rnnTimeStep ~:2100):
+        feeds one or more timesteps, keeps hidden state between calls."""
+        x = jnp.asarray(x)
+        squeeze = x.ndim == 2
+        if squeeze:
+            x = x[:, None, :]
+        carries = self._rnn_state or self._zero_carries(x.shape[0], self._dtype)
+        out, _, _, new_carries, _ = self._forward(
+            self.params, self.states, x, train=False, rng=None,
+            initial_carries=carries)
+        self._rnn_state = new_carries
+        return out[:, -1] if squeeze and out.ndim == 3 else out
+
+    def rnn_clear_previous_state(self):
+        self._rnn_state = {}
+
+    def rnn_get_previous_state(self, layer_idx):
+        return self._rnn_state.get(str(layer_idx))
+
+    def rnn_set_previous_state(self, layer_idx, state):
+        self._rnn_state[str(layer_idx)] = state
+
+    # ------------------------------------------------------------ pretrain
+    def pretrain(self, data, epochs=1):
+        """Greedy layerwise unsupervised pretraining for AE/RBM/VAE layers
+        (reference: pretrain :164)."""
+        for i, layer in enumerate(self.layers):
+            if layer.is_pretrainable():
+                self.pretrain_layer(i, data, epochs)
+        return self
+
+    def pretrain_layer(self, idx, data, epochs=1):
+        from ...datasets.iterator.base import as_iterator
+        layer = self.layers[idx]
+        if not layer.is_pretrainable():
+            return self
+        lc = self.conf.layers[idx]
+        tx = lc.updater.to_optax()
+        lp = self.params[str(idx)]
+        opt_state = tx.init(lp)
+
+        @jax.jit
+        def pstep(lp, opt_state, rng, feats):
+            def loss_fn(p):
+                return layer.pretrain_loss(p, feats, rng)
+            loss, grads = jax.value_and_grad(loss_fn)(lp)
+            updates, opt_state = tx.update(grads, opt_state, lp)
+            return optax.apply_updates(lp, updates), opt_state, loss
+
+        it = as_iterator(data)
+        for _ in range(epochs):
+            it.reset()
+            for ds in it:
+                x = jnp.asarray(ds.features, self._dtype)
+                full = dict(self.params)
+                full[str(idx)] = lp
+                feats, _, _, _, _ = self._forward(full, self.states, x, train=False,
+                                                  rng=None, to_layer=idx)
+                feats, _ = self._apply_preprocessor(idx, feats, None)
+                self._rng, sub = jax.random.split(self._rng)
+                lp, opt_state, loss = pstep(lp, opt_state, sub, feats)
+                self.score_value = float(loss)
+        self.params[str(idx)] = lp
+        return self
+
+    # -------------------------------------------------------------- params
+    def param_table(self):
+        """{(layer, name): array} (reference: Model.paramTable)."""
+        out = {}
+        for i, p in self.params.items():
+            for k, v in p.items():
+                out[f"{i}_{k}"] = v
+        return out
+
+    def num_params(self):
+        return sum(int(x.size) for x in jax.tree_util.tree_leaves(self.params))
+
+    def get_flat_params(self):
+        """Flattened param vector in deterministic (layer, name) order —
+        the analog of the reference's flattened view (Model.params())."""
+        leaves = []
+        for i in range(len(self.layers)):
+            p = self.params[str(i)]
+            for k in sorted(p.keys()):
+                leaves.append(np.asarray(p[k]).ravel())
+        if not leaves:
+            return np.zeros((0,), np.float32)
+        return np.concatenate(leaves)
+
+    def set_flat_params(self, flat):
+        flat = np.asarray(flat)
+        off = 0
+        for i in range(len(self.layers)):
+            p = self.params[str(i)]
+            for k in sorted(p.keys()):
+                n = int(np.prod(p[k].shape)) if p[k].shape else 1
+                p[k] = jnp.asarray(flat[off:off + n].reshape(p[k].shape), p[k].dtype)
+                off += n
+        return self
+
+    def set_params(self, params):
+        self.params = jax.tree_util.tree_map(jnp.asarray, params)
+        return self
+
+    def set_listeners(self, *listeners):
+        self.listeners = resolve_listeners(listeners)
+        return self
+
+    def add_listener(self, listener):
+        self.listeners.append(listener)
+        return self
+
+    # ------------------------------------------------------------ evaluate
+    def evaluate(self, iterator):
+        from ...eval.evaluation import Evaluation
+        from ...datasets.iterator.base import as_iterator
+        e = Evaluation()
+        it = as_iterator(iterator)
+        it.reset()
+        for ds in it:
+            out = self.output(ds.features)
+            e.eval(np.asarray(ds.labels), np.asarray(out),
+                   None if ds.labels_mask is None else np.asarray(ds.labels_mask))
+        return e
+
+    def clone(self):
+        net = MultiLayerNetwork(self.conf)
+        if self.params is not None:
+            net.init(params=jax.tree_util.tree_map(jnp.array, self.params))
+            net.states = jax.tree_util.tree_map(jnp.array, self.states)
+        return net
+
+    def summary(self):
+        lines = ["idx | layer | params"]
+        for i, (lc, layer) in enumerate(zip(self.conf.layers, self.layers)):
+            n = sum(int(x.size) for x in jax.tree_util.tree_leaves(self.params[str(i)])) \
+                if self.params else 0
+            lines.append(f"{i} | {type(lc).__name__} | {n}")
+        lines.append(f"total params: {self.num_params() if self.params else 0}")
+        return "\n".join(lines)

@@ -1,0 +1,99 @@
+"""Weight initialization schemes.
+
+Capability parity with the reference's WeightInit enum + WeightInitUtil
+(reference: nn/weights/WeightInit.java, nn/weights/WeightInitUtil.java).
+fan_in/fan_out semantics follow the reference: for a [nOut, nIn] dense kernel
+fanIn = nIn, fanOut = nOut; for conv kernels fan includes the receptive field.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+
+class WeightInit:
+    ZERO = "zero"
+    ONES = "ones"
+    UNIFORM = "uniform"
+    NORMAL = "normal"
+    XAVIER = "xavier"
+    XAVIER_UNIFORM = "xavier_uniform"
+    XAVIER_FAN_IN = "xavier_fan_in"
+    XAVIER_LEGACY = "xavier_legacy"
+    RELU = "relu"
+    RELU_UNIFORM = "relu_uniform"
+    SIGMOID_UNIFORM = "sigmoid_uniform"
+    LECUN_NORMAL = "lecun_normal"
+    LECUN_UNIFORM = "lecun_uniform"
+    IDENTITY = "identity"
+    VAR_SCALING_NORMAL_FAN_IN = "var_scaling_normal_fan_in"
+    DISTRIBUTION = "distribution"
+
+
+def init_weights(rng, shape, scheme=WeightInit.XAVIER, fan_in=None, fan_out=None,
+                 distribution=None, dtype=jnp.float32):
+    """Initialize a weight tensor.
+
+    `distribution` is a dict like {"type": "normal"|"uniform", ...} used with
+    WeightInit.DISTRIBUTION (mirrors the reference's Distribution configs)."""
+    shape = tuple(int(s) for s in shape)
+    if fan_in is None or fan_out is None:
+        if len(shape) == 2:
+            fan_out_d, fan_in_d = shape
+        elif len(shape) > 2:
+            receptive = 1
+            for s in shape[2:]:
+                receptive *= s
+            fan_in_d = shape[1] * receptive
+            fan_out_d = shape[0] * receptive
+        else:
+            fan_in_d = fan_out_d = shape[0] if shape else 1
+        fan_in = fan_in if fan_in is not None else fan_in_d
+        fan_out = fan_out if fan_out is not None else fan_out_d
+    fan_in = max(float(fan_in), 1.0)
+    fan_out = max(float(fan_out), 1.0)
+
+    s = str(scheme).lower()
+    if s == WeightInit.ZERO:
+        return jnp.zeros(shape, dtype)
+    if s == WeightInit.ONES:
+        return jnp.ones(shape, dtype)
+    if s == WeightInit.UNIFORM:
+        a = 1.0 / jnp.sqrt(fan_in)
+        return jax.random.uniform(rng, shape, dtype, -a, a)
+    if s == WeightInit.NORMAL:
+        return jax.random.normal(rng, shape, dtype) / jnp.sqrt(fan_in)
+    if s in (WeightInit.XAVIER, WeightInit.XAVIER_LEGACY):
+        std = jnp.sqrt(2.0 / (fan_in + fan_out))
+        return std * jax.random.normal(rng, shape, dtype)
+    if s == WeightInit.XAVIER_UNIFORM:
+        a = jnp.sqrt(6.0 / (fan_in + fan_out))
+        return jax.random.uniform(rng, shape, dtype, -a, a)
+    if s in (WeightInit.XAVIER_FAN_IN, WeightInit.LECUN_NORMAL, WeightInit.VAR_SCALING_NORMAL_FAN_IN):
+        return jax.random.normal(rng, shape, dtype) * jnp.sqrt(1.0 / fan_in)
+    if s == WeightInit.RELU:
+        return jax.random.normal(rng, shape, dtype) * jnp.sqrt(2.0 / fan_in)
+    if s == WeightInit.RELU_UNIFORM:
+        a = jnp.sqrt(6.0 / fan_in)
+        return jax.random.uniform(rng, shape, dtype, -a, a)
+    if s == WeightInit.SIGMOID_UNIFORM:
+        a = 4.0 * jnp.sqrt(6.0 / (fan_in + fan_out))
+        return jax.random.uniform(rng, shape, dtype, -a, a)
+    if s == WeightInit.LECUN_UNIFORM:
+        a = jnp.sqrt(3.0 / fan_in)
+        return jax.random.uniform(rng, shape, dtype, -a, a)
+    if s == WeightInit.IDENTITY:
+        if len(shape) == 2 and shape[0] == shape[1]:
+            return jnp.eye(shape[0], dtype=dtype)
+        raise ValueError("IDENTITY init requires a square 2-D shape")
+    if s == WeightInit.DISTRIBUTION:
+        d = distribution or {"type": "normal", "mean": 0.0, "std": 1.0}
+        t = d.get("type", "normal")
+        if t == "normal" or t == "gaussian":
+            return d.get("mean", 0.0) + d.get("std", 1.0) * jax.random.normal(rng, shape, dtype)
+        if t == "uniform":
+            return jax.random.uniform(rng, shape, dtype, d.get("lower", -1.0), d.get("upper", 1.0))
+        if t == "binomial":
+            return jax.random.bernoulli(rng, d.get("p", 0.5), shape).astype(dtype) * d.get("n", 1)
+        raise ValueError(f"Unknown distribution type {t}")
+    raise ValueError(f"Unknown weight init scheme '{scheme}'")

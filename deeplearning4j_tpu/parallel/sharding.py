@@ -1,0 +1,214 @@
+"""SPMD sharding: device meshes + sharded train steps.
+
+This replaces ALL of the reference's parallelism machinery with compiler-driven
+SPMD (reference inventory, SURVEY.md §2.4):
+- P3 ParallelWrapper replica averaging (ParallelWrapper.java:370-381,
+  Nd4j.averageAndPropagate) -> gradient all-reduce over ICI *inside* the
+  compiled step (mathematically the gradient-averaging limit of
+  averagingFrequency=1).
+- P4 Aeron parameter server (ParameterServerParallelWrapper.java) -> subsumed:
+  no user-space transport; XLA collectives ride ICI/DCN.
+- P5 Spark ParameterAveragingTrainingMaster -> multi-host pjit: the driver
+  disappears into SPMD; jax.distributed handles process bootstrap.
+Plus NEW capabilities the reference lacks (§2.4 "Absent"): tensor parallelism
+and sequence parallelism via sharding annotations on the same step.
+
+Design: `MeshPlan` names the axes (data/model/sequence); `shard_params` applies
+PartitionSpec rules per parameter; `sharded_train_step` wraps a model's train
+step in jit with in/out shardings so GSPMD inserts all-reduce/all-gather.
+"""
+from __future__ import annotations
+
+import re
+from dataclasses import dataclass, field
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+DATA_AXIS = "data"
+MODEL_AXIS = "model"
+SEQ_AXIS = "seq"
+
+
+def make_mesh(n_data=None, n_model=1, n_seq=1, devices=None):
+    """Build a Mesh with (data, model, seq) axes. Defaults to all devices on
+    the data axis."""
+    devices = devices if devices is not None else jax.devices()
+    n_total = len(devices)
+    if n_data is None:
+        n_data = n_total // (n_model * n_seq)
+    assert n_data * n_model * n_seq == n_total, \
+        f"mesh {n_data}x{n_model}x{n_seq} != {n_total} devices"
+    arr = np.array(devices).reshape(n_data, n_model, n_seq)
+    return Mesh(arr, (DATA_AXIS, MODEL_AXIS, SEQ_AXIS))
+
+
+@dataclass
+class ShardingRules:
+    """Regex path -> PartitionSpec rules for parameters. First match wins.
+
+    Paths look like "3/W" (MultiLayerNetwork) or "dense/W" (ComputationGraph),
+    with nested dicts joined by '/'.
+    """
+    rules: list = field(default_factory=list)  # [(compiled_regex, PartitionSpec)]
+
+    def add(self, pattern, spec):
+        self.rules.append((re.compile(pattern), spec))
+        return self
+
+    def spec_for(self, path, ndim):
+        for rx, spec in self.rules:
+            if rx.search(path):
+                return spec
+        return P()  # replicated
+
+    @staticmethod
+    def data_parallel():
+        """Pure DP: everything replicated."""
+        return ShardingRules()
+
+    @staticmethod
+    def tensor_parallel_dense():
+        """Megatron-style TP for dense stacks: shard the output dim of
+        kernels ending in 'W' over the model axis (new capability — no
+        reference counterpart; SURVEY.md §2.4 'Absent')."""
+        r = ShardingRules()
+        r.add(r"(^|/)W$", P(None, MODEL_AXIS))
+        r.add(r"(^|/)b$", P(MODEL_AXIS))
+        return r
+
+
+def _param_paths(tree, prefix=""):
+    out = {}
+    if isinstance(tree, dict):
+        for k, v in tree.items():
+            out.update(_param_paths(v, f"{prefix}{k}/"))
+    elif isinstance(tree, (list, tuple)):
+        for i, v in enumerate(tree):
+            out.update(_param_paths(v, f"{prefix}{i}/"))
+    elif tree is not None:
+        out[prefix[:-1]] = tree
+    return out
+
+
+def param_shardings(params, mesh, rules: ShardingRules):
+    """Pytree of NamedShardings matching `params`."""
+    def assign(path, leaf):
+        spec = rules.spec_for(path, getattr(leaf, "ndim", 0))
+        # drop trailing None axes beyond rank, guard rank mismatch
+        if len(spec) > getattr(leaf, "ndim", 0):
+            spec = P(*spec[:leaf.ndim])
+        return NamedSharding(mesh, spec)
+    flat = _param_paths(params)
+    specs = {p: assign(p, l) for p, l in flat.items()}
+
+    def rebuild(tree, prefix=""):
+        if isinstance(tree, dict):
+            return {k: rebuild(v, f"{prefix}{k}/") for k, v in tree.items()}
+        if isinstance(tree, (list, tuple)):
+            vals = [rebuild(v, f"{prefix}{i}/") for i, v in enumerate(tree)]
+            return tuple(vals) if isinstance(tree, tuple) else vals
+        if tree is None:
+            return None
+        return specs[prefix[:-1]]
+    return rebuild(params)
+
+
+def batch_sharding(mesh, ndim, seq_axis=None):
+    """Batch arrays sharded over the data axis (and optionally time over seq)."""
+    spec = [DATA_AXIS] + [None] * (ndim - 1)
+    if seq_axis is not None and ndim >= 2:
+        spec[1] = SEQ_AXIS
+    return NamedSharding(mesh, P(*spec))
+
+
+class ShardedTrainer:
+    """Data/tensor-parallel training for a MultiLayerNetwork or
+    ComputationGraph over a Mesh. The per-replica semantics of the reference's
+    ParallelWrapper (models on N devices, gradients combined) with the
+    combination compiled into the step as an XLA all-reduce.
+    """
+
+    def __init__(self, model, mesh=None, rules=None):
+        self.model = model
+        self.mesh = mesh if mesh is not None else make_mesh()
+        self.rules = rules or ShardingRules.data_parallel()
+        if model.params is None:
+            model.init()
+        self._place()
+        self._step = None
+
+    def _place(self):
+        m = self.model
+        pshard = param_shardings(m.params, self.mesh, self.rules)
+        m.params = jax.tree_util.tree_map(
+            lambda x, s: jax.device_put(x, s), m.params, pshard)
+        self._pshard = pshard
+        repl = NamedSharding(self.mesh, P())
+        m.states = jax.tree_util.tree_map(lambda x: jax.device_put(x, repl), m.states)
+        m.opt_state = jax.tree_util.tree_map(
+            lambda x: jax.device_put(x, repl) if hasattr(x, "shape") else x,
+            m.opt_state)
+
+    def _build_step(self):
+        """Reuse the model's own canonical train step (single source of truth);
+        sharded inputs make GSPMD partition it and insert the collectives."""
+        return self.model._make_train_step()
+
+    def _put_batch(self, arr, dtype=None):
+        a = jnp.asarray(arr) if dtype is None else jnp.asarray(arr, dtype)
+        return jax.device_put(a, batch_sharding(self.mesh, a.ndim))
+
+    def fit_batch(self, ds):
+        """One globally-batched step: the batch is split over the data axis;
+        XLA all-reduces gradients over ICI."""
+        m = self.model
+        if self._step is None:
+            self._step = self._build_step()
+        from ..nn.multilayer.network import MultiLayerNetwork
+        is_mln = isinstance(m, MultiLayerNetwork)
+        m._rng, rng = jax.random.split(m._rng)
+        with self.mesh:
+            if is_mln:
+                x = self._put_batch(ds.features)
+                y = self._put_batch(ds.labels, m._dtype)
+                mask = None if ds.features_mask is None else \
+                    self._put_batch(ds.features_mask, m._dtype)
+                lmask = None if ds.labels_mask is None else \
+                    self._put_batch(ds.labels_mask, m._dtype)
+                out = self._step(m.params, m.opt_state, m.states, rng, x, y,
+                                 mask, lmask, None)
+                m.params, m.opt_state, m.states, score, _ = out
+            else:
+                from ..datasets.dataset import MultiDataSet, DataSet as DS
+                if isinstance(ds, DS):
+                    ds = MultiDataSet([ds.features], [ds.labels],
+                                      None if ds.features_mask is None else [ds.features_mask],
+                                      None if ds.labels_mask is None else [ds.labels_mask])
+                xs = [self._put_batch(f) for f in ds.features]
+                ys = [self._put_batch(l, m._dtype) for l in ds.labels]
+                masks = None if ds.features_masks is None else \
+                    [None if mm is None else self._put_batch(mm, m._dtype)
+                     for mm in ds.features_masks]
+                lmasks = None if ds.labels_masks is None else \
+                    [None if mm is None else self._put_batch(mm, m._dtype)
+                     for mm in ds.labels_masks]
+                out = self._step(m.params, m.opt_state, m.states, rng, xs, ys,
+                                 masks, lmasks)
+                m.params, m.opt_state, m.states, score = out
+        m.score_value = float(score)
+        m.iteration_count += 1
+        for listener in m.listeners:
+            listener.iteration_done(m, m.iteration_count)
+        return m.score_value
+
+    def fit(self, iterator, epochs=1):
+        from ..datasets.iterator.base import as_iterator  # type: ignore
+        it = as_iterator(iterator) if not hasattr(iterator, "reset") else iterator
+        for _ in range(epochs):
+            it.reset()
+            for ds in it:
+                self.fit_batch(ds)
+        return self.model

@@ -1,0 +1,165 @@
+"""Model zoo: the BASELINE.json configs + the reference's embryonic zoo.
+
+Reference: trainedmodels/TrainedModels.java (VGG16); BASELINE configs:
+LeNet/MNIST MultiLayerNetwork, ResNet-50 ComputationGraph, GravesLSTM char-RNN.
+All built through the public config DSL — these dual as integration tests of
+the builder.
+"""
+from __future__ import annotations
+
+from ..nn.conf.configuration import NeuralNetConfiguration
+from ..nn.conf.inputs import InputType
+from ..nn.conf.layers import (DenseLayer, OutputLayer, RnnOutputLayer,
+                              ConvolutionLayer, SubsamplingLayer,
+                              BatchNormalization, ActivationLayer, GravesLSTM,
+                              GlobalPoolingLayer)
+from ..nn.conf.graph_configuration import ElementWiseVertex
+from ..nn.updaters import Adam, Nesterovs
+from ..nn.multilayer.network import MultiLayerNetwork
+from ..nn.graph.graph import ComputationGraph
+
+
+def lenet_mnist(seed=12345, updater=None):
+    """LeNet-style CNN for MNIST (BASELINE config #1; mirrors the classic DL4J
+    LeNet example built on the reference's ConvolutionLayer/SubsamplingLayer)."""
+    conf = (NeuralNetConfiguration.builder()
+            .seed(seed)
+            .updater(updater or Nesterovs(learning_rate=0.01, momentum=0.9))
+            .weight_init("xavier")
+            .list()
+            .layer(ConvolutionLayer(kernel_size=(5, 5), stride=(1, 1), n_out=20,
+                                    activation="identity"))
+            .layer(SubsamplingLayer(pooling_type="max", kernel_size=(2, 2),
+                                    stride=(2, 2)))
+            .layer(ConvolutionLayer(kernel_size=(5, 5), stride=(1, 1), n_out=50,
+                                    activation="identity"))
+            .layer(SubsamplingLayer(pooling_type="max", kernel_size=(2, 2),
+                                    stride=(2, 2)))
+            .layer(DenseLayer(n_out=500, activation="relu"))
+            .layer(OutputLayer(n_out=10, activation="softmax", loss="MCXENT"))
+            .input_type(InputType.convolutional(28, 28, 1))
+            .build())
+    return MultiLayerNetwork(conf)
+
+
+def mlp_mnist(seed=12345, hidden=512):
+    conf = (NeuralNetConfiguration.builder()
+            .seed(seed).updater(Adam(1e-3)).weight_init("relu")
+            .list()
+            .layer(DenseLayer(n_out=hidden, activation="relu"))
+            .layer(DenseLayer(n_out=hidden // 2, activation="relu"))
+            .layer(OutputLayer(n_out=10, activation="softmax", loss="MCXENT"))
+            .input_type(InputType.feed_forward(784))
+            .build())
+    return MultiLayerNetwork(conf)
+
+
+def char_rnn_lstm(vocab_size=80, hidden=256, layers=2, seed=12345, tbptt=50):
+    """GravesLSTM char-RNN (BASELINE config #3)."""
+    from ..nn.conf.configuration import BackpropType
+    b = (NeuralNetConfiguration.builder()
+         .seed(seed).updater(Adam(2e-3)).weight_init("xavier")
+         .list())
+    for _ in range(layers):
+        b.layer(GravesLSTM(n_out=hidden, activation="tanh"))
+    b.layer(RnnOutputLayer(n_out=vocab_size, activation="softmax", loss="MCXENT"))
+    b.set_input_type(InputType.recurrent(vocab_size))
+    b.backprop_type(BackpropType.TRUNCATED_BPTT)
+    b.tbptt_fwd_length(tbptt).tbptt_back_length(tbptt)
+    return MultiLayerNetwork(b.build())
+
+
+def _resnet_conv_block(gb, name, n_in_name, filters, stride, bottleneck=True,
+                       project=True):
+    """One ResNet v1 bottleneck block: conv1x1 -> conv3x3 -> conv1x1 + skip."""
+    f1, f2, f3 = filters
+    gb.add_layer(f"{name}_c1", ConvolutionLayer(kernel_size=(1, 1), stride=(stride, stride),
+                                                n_out=f1, activation="identity",
+                                                convolution_mode="same", has_bias=False),
+                 n_in_name)
+    gb.add_layer(f"{name}_bn1", BatchNormalization(activation="relu"), f"{name}_c1")
+    gb.add_layer(f"{name}_c2", ConvolutionLayer(kernel_size=(3, 3), stride=(1, 1),
+                                                n_out=f2, activation="identity",
+                                                convolution_mode="same", has_bias=False),
+                 f"{name}_bn1")
+    gb.add_layer(f"{name}_bn2", BatchNormalization(activation="relu"), f"{name}_c2")
+    gb.add_layer(f"{name}_c3", ConvolutionLayer(kernel_size=(1, 1), stride=(1, 1),
+                                                n_out=f3, activation="identity",
+                                                convolution_mode="same", has_bias=False),
+                 f"{name}_bn2")
+    gb.add_layer(f"{name}_bn3", BatchNormalization(activation="identity"), f"{name}_c3")
+    if project:
+        gb.add_layer(f"{name}_proj", ConvolutionLayer(kernel_size=(1, 1),
+                                                      stride=(stride, stride), n_out=f3,
+                                                      activation="identity",
+                                                      convolution_mode="same",
+                                                      has_bias=False),
+                     n_in_name)
+        gb.add_layer(f"{name}_projbn", BatchNormalization(activation="identity"),
+                     f"{name}_proj")
+        skip = f"{name}_projbn"
+    else:
+        skip = n_in_name
+    gb.add_vertex(f"{name}_add", ElementWiseVertex("add"), f"{name}_bn3", skip)
+    gb.add_layer(f"{name}_relu", ActivationLayer(activation="relu"), f"{name}_add")
+    return f"{name}_relu"
+
+
+def resnet50(num_classes=1000, image_size=224, seed=12345, updater=None):
+    """ResNet-50 as a ComputationGraph (BASELINE config #2). Structure follows
+    the standard [3,4,6,3] bottleneck stacking; built from the same layer/vertex
+    vocabulary the reference exposes (ConvolutionLayer, BatchNormalization,
+    ElementWiseVertex add = residual)."""
+    gb = (NeuralNetConfiguration.builder()
+          .seed(seed).updater(updater or Nesterovs(learning_rate=0.1, momentum=0.9))
+          .weight_init("relu")
+          .graph_builder()
+          .add_inputs("in"))
+    gb.add_layer("stem_conv", ConvolutionLayer(kernel_size=(7, 7), stride=(2, 2),
+                                               n_out=64, activation="identity",
+                                               convolution_mode="same", has_bias=False),
+                 "in")
+    gb.add_layer("stem_bn", BatchNormalization(activation="relu"), "stem_conv")
+    gb.add_layer("stem_pool", SubsamplingLayer(pooling_type="max", kernel_size=(3, 3),
+                                               stride=(2, 2), convolution_mode="same"),
+                 "stem_bn")
+    prev = "stem_pool"
+    stages = [
+        ("s2", (64, 64, 256), 3, 1),
+        ("s3", (128, 128, 512), 4, 2),
+        ("s4", (256, 256, 1024), 6, 2),
+        ("s5", (512, 512, 2048), 3, 2),
+    ]
+    for sname, filters, blocks, stride in stages:
+        prev = _resnet_conv_block(gb, f"{sname}b1", prev, filters, stride, project=True)
+        for i in range(1, blocks):
+            prev = _resnet_conv_block(gb, f"{sname}b{i+1}", prev, filters, 1,
+                                      project=False)
+    gb.add_layer("avgpool", GlobalPoolingLayer(pooling_type="avg"), prev)
+    gb.add_layer("out", OutputLayer(n_out=num_classes, activation="softmax",
+                                    loss="MCXENT"), "avgpool")
+    gb.set_outputs("out")
+    gb.set_input_types(InputType.convolutional(image_size, image_size, 3))
+    return ComputationGraph(gb.build())
+
+
+def vgg16(num_classes=1000, image_size=224, seed=12345):
+    """VGG16 (reference: trainedmodels/TrainedModels.java VGG16)."""
+    b = (NeuralNetConfiguration.builder()
+         .seed(seed).updater(Nesterovs(learning_rate=0.01, momentum=0.9))
+         .weight_init("relu")
+         .list())
+    cfg = [64, 64, "M", 128, 128, "M", 256, 256, 256, "M", 512, 512, 512, "M",
+           512, 512, 512, "M"]
+    for v in cfg:
+        if v == "M":
+            b.layer(SubsamplingLayer(pooling_type="max", kernel_size=(2, 2),
+                                     stride=(2, 2)))
+        else:
+            b.layer(ConvolutionLayer(kernel_size=(3, 3), stride=(1, 1), n_out=v,
+                                     activation="relu", convolution_mode="same"))
+    b.layer(DenseLayer(n_out=4096, activation="relu"))
+    b.layer(DenseLayer(n_out=4096, activation="relu"))
+    b.layer(OutputLayer(n_out=num_classes, activation="softmax", loss="MCXENT"))
+    b.set_input_type(InputType.convolutional(image_size, image_size, 3))
+    return MultiLayerNetwork(b.build())

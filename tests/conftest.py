@@ -1,0 +1,22 @@
+"""Test configuration: force an 8-device virtual CPU mesh so multi-chip sharding
+paths (pjit / shard_map over a Mesh) are exercised without TPU hardware.
+
+Mirrors the reference's strategy of testing distributed semantics in-process
+(reference: deeplearning4j-scaleout/spark/dl4j-spark/src/test/java/org/deeplearning4j/spark/BaseSparkTest.java:90
+uses master=local[n]); here N virtual XLA CPU devices play that role.
+"""
+import os
+
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = _flags + " --xla_force_host_platform_device_count=8"
+
+import jax
+
+# The environment's sitecustomize pins JAX_PLATFORMS=axon (one real TPU chip);
+# the env var is overridden before import, so force CPU via the config API.
+jax.config.update("jax_platforms", "cpu")
+
+# Gradient checks follow the reference's double-precision-on-CPU strategy
+# (reference: gradientcheck/GradientCheckUtil.java:29-38 requires DOUBLE dtype).
+jax.config.update("jax_enable_x64", True)

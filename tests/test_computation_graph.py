@@ -1,0 +1,219 @@
+"""ComputationGraph tests (reference: nn/graph tests +
+GradientCheckTestsComputationGraph.java — every vertex type).
+"""
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu import (NeuralNetConfiguration, InputType, DenseLayer,
+                                OutputLayer, RnnOutputLayer, GravesLSTM,
+                                ComputationGraph, MultiDataSet, DataSet,
+                                ElementWiseVertex, MergeVertex, SubsetVertex,
+                                StackVertex, UnstackVertex, ScaleVertex,
+                                L2NormalizeVertex, L2Vertex, LastTimeStepVertex,
+                                DuplicateToTimeSeriesVertex, Adam, NoOp,
+                                ComputationGraphConfiguration, ModelSerializer)
+
+
+def _simple_graph_conf(nin=4, nout=3):
+    return (NeuralNetConfiguration.builder()
+            .seed(42).updater(Adam(1e-2))
+            .graph_builder()
+            .add_inputs("in")
+            .add_layer("dense", DenseLayer(n_out=16, activation="relu"), "in")
+            .add_layer("out", OutputLayer(n_out=nout, activation="softmax",
+                                          loss="MCXENT"), "dense")
+            .set_outputs("out")
+            .set_input_types(InputType.feed_forward(nin))
+            .build())
+
+
+def test_graph_fit():
+    rng = np.random.default_rng(0)
+    X = rng.normal(size=(128, 4)).astype(np.float32)
+    w = rng.normal(size=(4, 3))
+    Y = np.eye(3, dtype=np.float32)[np.argmax(X @ w, axis=1)]
+    g = ComputationGraph(_simple_graph_conf()).init()
+    s0 = g.score(DataSet(X, Y))
+    g.fit([MultiDataSet([X], [Y])], epochs=30)
+    assert g.score(DataSet(X, Y)) < s0 * 0.5
+    out = g.output(X)
+    assert out.shape == (128, 3)
+
+
+def test_graph_json_roundtrip():
+    conf = _simple_graph_conf()
+    j = conf.to_json()
+    conf2 = ComputationGraphConfiguration.from_json(j)
+    g1 = ComputationGraph(conf).init()
+    g2 = ComputationGraph(conf2).init(params=g1.params)
+    x = np.random.default_rng(0).normal(size=(4, 4)).astype(np.float32)
+    np.testing.assert_allclose(np.asarray(g1.output(x)), np.asarray(g2.output(x)),
+                               rtol=1e-6)
+
+
+def test_graph_serializer_roundtrip(tmp_path):
+    g = ComputationGraph(_simple_graph_conf()).init()
+    path = str(tmp_path / "graph.zip")
+    ModelSerializer.write_model(g, path)
+    g2 = ModelSerializer.restore(path)
+    assert isinstance(g2, ComputationGraph)
+    x = np.random.default_rng(0).normal(size=(4, 4)).astype(np.float32)
+    np.testing.assert_allclose(np.asarray(g.output(x)), np.asarray(g2.output(x)),
+                               rtol=1e-6)
+
+
+def test_multi_input_merge_and_elementwise():
+    rng = np.random.default_rng(1)
+    x1 = rng.normal(size=(8, 3)).astype(np.float32)
+    x2 = rng.normal(size=(8, 3)).astype(np.float32)
+    Y = np.eye(2, dtype=np.float32)[rng.integers(0, 2, 8)]
+    conf = (NeuralNetConfiguration.builder()
+            .seed(1).updater(Adam(1e-2))
+            .graph_builder()
+            .add_inputs("a", "b")
+            .add_layer("da", DenseLayer(n_out=5, activation="tanh"), "a")
+            .add_layer("db", DenseLayer(n_out=5, activation="tanh"), "b")
+            .add_vertex("sum", ElementWiseVertex("add"), "da", "db")
+            .add_vertex("merge", MergeVertex(), "da", "db")
+            .add_vertex("scaled", ScaleVertex(0.5), "sum")
+            .add_vertex("norm", L2NormalizeVertex(), "merge")
+            .add_vertex("cat", MergeVertex(), "scaled", "norm")
+            .add_layer("out", OutputLayer(n_out=2, activation="softmax",
+                                          loss="MCXENT"), "cat")
+            .set_outputs("out")
+            .set_input_types(InputType.feed_forward(3), InputType.feed_forward(3))
+            .build())
+    g = ComputationGraph(conf).init()
+    md = MultiDataSet([x1, x2], [Y])
+    s0 = g.score(md_to_ds(md)) if False else None
+    g.fit([md], epochs=20)
+    out = g.output(x1, x2)
+    assert out.shape == (8, 2)
+
+
+def md_to_ds(md):
+    return DataSet(md.features[0], md.labels[0])
+
+
+def test_subset_stack_unstack():
+    rng = np.random.default_rng(2)
+    x = rng.normal(size=(6, 8)).astype(np.float32)
+    Y = np.eye(2, dtype=np.float32)[rng.integers(0, 2, 6)]
+    conf = (NeuralNetConfiguration.builder()
+            .seed(1).updater(Adam(1e-2))
+            .graph_builder()
+            .add_inputs("in")
+            .add_vertex("first4", SubsetVertex(0, 3), "in")
+            .add_vertex("last4", SubsetVertex(4, 7), "in")
+            .add_vertex("stacked", StackVertex(), "first4", "last4")
+            .add_layer("d", DenseLayer(n_out=6, activation="tanh"), "stacked")
+            .add_vertex("u0", UnstackVertex(0, 2), "d")
+            .add_vertex("u1", UnstackVertex(1, 2), "d")
+            .add_vertex("joined", MergeVertex(), "u0", "u1")
+            .add_layer("out", OutputLayer(n_out=2, activation="softmax",
+                                          loss="MCXENT"), "joined")
+            .set_outputs("out")
+            .set_input_types(InputType.feed_forward(8))
+            .build())
+    g = ComputationGraph(conf).init()
+    g.fit([MultiDataSet([x], [Y])], epochs=5)
+    assert g.output(x).shape == (6, 2)
+
+
+def test_rnn_vertices_seq2seq_style():
+    """LastTimeStep + DuplicateToTimeSeries (reference:
+    nn/conf/graph/rnn/*, seq2seq pattern)."""
+    rng = np.random.default_rng(3)
+    b, t, f = 4, 6, 5
+    x = rng.normal(size=(b, t, f)).astype(np.float32)
+    y = np.eye(3, dtype=np.float32)[rng.integers(0, 3, (b, t))]
+    conf = (NeuralNetConfiguration.builder()
+            .seed(1).updater(Adam(1e-2))
+            .graph_builder()
+            .add_inputs("in")
+            .add_layer("enc", GravesLSTM(n_out=7, activation="tanh"), "in")
+            .add_vertex("last", LastTimeStepVertex("in"), "enc")
+            .add_vertex("dup", DuplicateToTimeSeriesVertex("in"), "last")
+            .add_layer("dec", GravesLSTM(n_out=7, activation="tanh"), "dup")
+            .add_layer("out", RnnOutputLayer(n_out=3, activation="softmax",
+                                             loss="MCXENT"), "dec")
+            .set_outputs("out")
+            .set_input_types(InputType.recurrent(f))
+            .build())
+    g = ComputationGraph(conf).init()
+    s0 = g.score(DataSet(x, y))
+    g.fit([MultiDataSet([x], [y])], epochs=15)
+    assert g.score(DataSet(x, y)) < s0
+    assert g.output(x).shape == (b, t, 3)
+
+
+def test_l2_vertex_siamese():
+    rng = np.random.default_rng(4)
+    x1 = rng.normal(size=(8, 4)).astype(np.float32)
+    x2 = rng.normal(size=(8, 4)).astype(np.float32)
+    y = rng.random((8, 1)).astype(np.float32)
+    conf = (NeuralNetConfiguration.builder()
+            .seed(1).updater(Adam(1e-2))
+            .graph_builder()
+            .add_inputs("a", "b")
+            .add_layer("da", DenseLayer(n_out=5, activation="tanh"), "a")
+            .add_layer("db", DenseLayer(n_out=5, activation="tanh"), "b")
+            .add_vertex("dist", L2Vertex(), "da", "db")
+            .add_layer("out", OutputLayer(n_out=1, activation="sigmoid",
+                                          loss="XENT"), "dist")
+            .set_outputs("out")
+            .set_input_types(InputType.feed_forward(4), InputType.feed_forward(4))
+            .build())
+    g = ComputationGraph(conf).init()
+    g.fit([MultiDataSet([x1, x2], [y])], epochs=5)
+    assert g.output(x1, x2).shape == (8, 1)
+
+
+def test_graph_gradient_check():
+    """Vertex gradient check (reference: GradientCheckTestsComputationGraph)."""
+    import jax, jax.numpy as jnp
+    rng = np.random.default_rng(5)
+    x1 = rng.normal(size=(4, 3))
+    x2 = rng.normal(size=(4, 3))
+    Y = np.eye(2)[rng.integers(0, 2, 4)]
+    conf = (NeuralNetConfiguration.builder()
+            .seed(1).updater(NoOp()).dtype("float64")
+            .graph_builder()
+            .add_inputs("a", "b")
+            .add_layer("da", DenseLayer(n_out=4, activation="tanh"), "a")
+            .add_layer("db", DenseLayer(n_out=4, activation="tanh"), "b")
+            .add_vertex("sum", ElementWiseVertex("add"), "da", "db")
+            .add_vertex("merge", MergeVertex(), "sum", "da")
+            .add_layer("out", OutputLayer(n_out=2, activation="softmax",
+                                          loss="MCXENT"), "merge")
+            .set_outputs("out")
+            .set_input_types(InputType.feed_forward(3), InputType.feed_forward(3))
+            .build())
+    g = ComputationGraph(conf).init()
+    inputs = [jnp.asarray(x1), jnp.asarray(x2)]
+    labels = [jnp.asarray(Y)]
+    grads, _ = g.compute_gradient_and_score(inputs, labels)
+
+    def score_with(params):
+        s, _ = g._loss(params, g.states, inputs, labels, train=False, rng=None)
+        return float(s)
+
+    eps = 1e-6
+    leaves, treedef = jax.tree_util.tree_flatten(g.params)
+    g_leaves = jax.tree_util.tree_leaves(grads)
+    for li, (arr, garr) in enumerate(zip(leaves, g_leaves)):
+        flat = np.asarray(arr).ravel().copy()
+        gf = np.asarray(garr).ravel()
+        for i in range(min(flat.size, 10)):
+            orig = flat[i]
+            flat[i] = orig + eps
+            nl = list(leaves); nl[li] = jnp.asarray(flat.reshape(arr.shape))
+            sp = score_with(jax.tree_util.tree_unflatten(treedef, nl))
+            flat[i] = orig - eps
+            nl = list(leaves); nl[li] = jnp.asarray(flat.reshape(arr.shape))
+            sm = score_with(jax.tree_util.tree_unflatten(treedef, nl))
+            flat[i] = orig
+            numeric = (sp - sm) / (2 * eps)
+            denom = abs(numeric) + abs(gf[i])
+            rel = abs(numeric - gf[i]) / denom if denom else 0.0
+            assert rel < 1e-3 or abs(numeric - gf[i]) < 1e-8

@@ -1,0 +1,207 @@
+"""Gradient checks — the correctness backbone, mirroring the reference's
+deeplearning4j-core/src/test/java/org/deeplearning4j/gradientcheck/ suites
+(GradientCheckTests, CNNGradientCheckTest, BNGradientCheckTest,
+LRNGradientCheckTests, GlobalPoolingGradientCheckTests, VaeGradientCheckTests,
+LossFunctionGradientCheck, GradientCheckTestsMasking).
+"""
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu import (NeuralNetConfiguration, InputType, DenseLayer,
+                                OutputLayer, RnnOutputLayer, ConvolutionLayer,
+                                SubsamplingLayer, BatchNormalization, GravesLSTM,
+                                LSTM, GravesBidirectionalLSTM, EmbeddingLayer,
+                                GlobalPoolingLayer, ActivationLayer,
+                                LocalResponseNormalization, ZeroPaddingLayer,
+                                AutoEncoder, VariationalAutoencoder,
+                                MultiLayerNetwork, Sgd, NoOp, WeightInit)
+from deeplearning4j_tpu.gradientcheck.gradient_check_util import check_gradients
+
+
+def _onehot(idx, n):
+    return np.eye(n)[idx]
+
+
+def _rand_cls(rng, b, nin, nout):
+    x = rng.normal(size=(b, nin))
+    y = _onehot(rng.integers(0, nout, b), nout)
+    return x, y
+
+
+def _build(layers, input_type, **kw):
+    b = (NeuralNetConfiguration.builder()
+         .seed(12345)
+         .updater(NoOp())
+         .dtype("float64")
+         .weight_init(kw.get("weight_init", WeightInit.XAVIER)))
+    if "l1" in kw:
+        b = b.l1(kw["l1"])
+    if "l2" in kw:
+        b = b.l2(kw["l2"])
+    lb = b.list()
+    for l in layers:
+        lb.layer(l)
+    lb.set_input_type(input_type)
+    return MultiLayerNetwork(lb.build()).init()
+
+
+@pytest.mark.parametrize("act,loss,out_act", [
+    ("relu", "MCXENT", "softmax"),
+    ("tanh", "MSE", "identity"),
+    ("sigmoid", "XENT", "sigmoid"),
+    ("elu", "MCXENT", "softmax"),
+    ("softplus", "L2", "tanh"),
+])
+def test_dense_gradients(act, loss, out_act):
+    rng = np.random.default_rng(0)
+    x, y = _rand_cls(rng, 8, 5, 3)
+    if loss == "XENT":
+        y = (rng.random((8, 3)) > 0.5).astype(float)
+    net = _build([DenseLayer(n_out=6, activation=act),
+                  OutputLayer(n_out=3, activation=out_act, loss=loss)],
+                 InputType.feed_forward(5))
+    assert check_gradients(net, x, y, print_results=True)
+
+
+def test_dense_l1_l2_gradients():
+    rng = np.random.default_rng(1)
+    x, y = _rand_cls(rng, 8, 5, 3)
+    net = _build([DenseLayer(n_out=6, activation="tanh"),
+                  OutputLayer(n_out=3, activation="softmax", loss="MCXENT")],
+                 InputType.feed_forward(5), l1=0.01, l2=0.02)
+    assert check_gradients(net, x, y, print_results=True)
+
+
+def test_cnn_gradients():
+    rng = np.random.default_rng(2)
+    x = rng.normal(size=(4, 8, 8, 2))
+    y = _onehot(rng.integers(0, 3, 4), 3)
+    net = _build([ConvolutionLayer(kernel_size=(3, 3), stride=(1, 1), n_out=4,
+                                   activation="tanh"),
+                  SubsamplingLayer(kernel_size=(2, 2), stride=(2, 2), pooling_type="max"),
+                  OutputLayer(n_out=3, activation="softmax", loss="MCXENT")],
+                 InputType.convolutional(8, 8, 2))
+    assert check_gradients(net, x, y, print_results=True)
+
+
+def test_cnn_avg_pool_zeropad_gradients():
+    rng = np.random.default_rng(3)
+    x = rng.normal(size=(3, 6, 6, 1))
+    y = _onehot(rng.integers(0, 2, 3), 2)
+    net = _build([ZeroPaddingLayer(pad_top=1, pad_bottom=1, pad_left=1, pad_right=1),
+                  ConvolutionLayer(kernel_size=(3, 3), n_out=3, activation="sigmoid"),
+                  SubsamplingLayer(kernel_size=(2, 2), stride=(2, 2), pooling_type="avg"),
+                  OutputLayer(n_out=2, activation="softmax", loss="MCXENT")],
+                 InputType.convolutional(6, 6, 1))
+    assert check_gradients(net, x, y, print_results=True)
+
+
+def test_batchnorm_gradients():
+    rng = np.random.default_rng(4)
+    x, y = _rand_cls(rng, 8, 5, 3)
+    net = _build([DenseLayer(n_out=6, activation="identity"),
+                  BatchNormalization(),
+                  ActivationLayer(activation="tanh"),
+                  OutputLayer(n_out=3, activation="softmax", loss="MCXENT")],
+                 InputType.feed_forward(5))
+    # BN uses batch statistics in train mode; check against train=False forward
+    # with running stats is inconsistent, so we check the train-mode loss:
+    # achieved by computing grads of the train-mode loss directly.
+    import jax, jax.numpy as jnp
+    x64 = jnp.asarray(x, jnp.float64)
+    y64 = jnp.asarray(y, jnp.float64)
+    net.params = jax.tree_util.tree_map(lambda p: jnp.asarray(p, jnp.float64), net.params)
+    net.states = jax.tree_util.tree_map(lambda s: jnp.asarray(s, jnp.float64), net.states)
+
+    def loss_fn(p):
+        s, _ = net._loss(p, net.states, x64, y64, train=True, rng=None)
+        return s
+    grads = jax.grad(loss_fn)(net.params)
+    eps = 1e-6
+    import numpy as onp
+    for lk in net.params:
+        for pn, arr in net.params[lk].items():
+            flat = onp.asarray(arr).ravel().copy()
+            gf = onp.asarray(grads[lk][pn]).ravel()
+            for i in range(min(flat.size, 20)):
+                orig = flat[i]
+                for sgn, store in ((1, "p"), (-1, "m")):
+                    flat[i] = orig + sgn * eps
+                    newp = {k: dict(v) for k, v in net.params.items()}
+                    newp[lk][pn] = jnp.asarray(flat.reshape(arr.shape))
+                    val = float(loss_fn(newp))
+                    if sgn == 1:
+                        sp = val
+                    else:
+                        sm = val
+                flat[i] = orig
+                numeric = (sp - sm) / (2 * eps)
+                denom = abs(numeric) + abs(gf[i])
+                rel = abs(numeric - gf[i]) / denom if denom else 0.0
+                assert rel < 1e-3 or abs(numeric - gf[i]) < 1e-8, \
+                    f"{lk}/{pn}[{i}] rel={rel}"
+
+
+def test_lrn_gradients():
+    rng = np.random.default_rng(5)
+    x = rng.normal(size=(3, 5, 5, 8))
+    y = _onehot(rng.integers(0, 2, 3), 2)
+    net = _build([ConvolutionLayer(kernel_size=(3, 3), n_out=8, activation="tanh"),
+                  LocalResponseNormalization(),
+                  OutputLayer(n_out=2, activation="softmax", loss="MCXENT")],
+                 InputType.convolutional(5, 5, 8))
+    assert check_gradients(net, x, y, print_results=True)
+
+
+@pytest.mark.parametrize("layer_cls", [GravesLSTM, LSTM, GravesBidirectionalLSTM])
+def test_lstm_gradients(layer_cls):
+    rng = np.random.default_rng(6)
+    b, t, nin, nout = 3, 4, 3, 2
+    x = rng.normal(size=(b, t, nin))
+    y = _onehot(rng.integers(0, nout, (b, t)).ravel(), nout).reshape(b, t, nout)
+    net = _build([layer_cls(n_out=4, activation="tanh"),
+                  RnnOutputLayer(n_out=nout, activation="softmax", loss="MCXENT")],
+                 InputType.recurrent(nin))
+    assert check_gradients(net, x, y, print_results=True)
+
+
+def test_lstm_masking_gradients():
+    rng = np.random.default_rng(7)
+    b, t, nin, nout = 3, 5, 3, 2
+    x = rng.normal(size=(b, t, nin))
+    y = _onehot(rng.integers(0, nout, (b, t)).ravel(), nout).reshape(b, t, nout)
+    mask = np.ones((b, t))
+    mask[0, 3:] = 0
+    mask[1, 2:] = 0
+    import jax.numpy as jnp
+    net = _build([GravesLSTM(n_out=4, activation="tanh"),
+                  RnnOutputLayer(n_out=nout, activation="softmax", loss="MCXENT")],
+                 InputType.recurrent(nin))
+    assert check_gradients(net, x, y, mask=jnp.asarray(mask, jnp.float64),
+                           label_mask=jnp.asarray(mask, jnp.float64),
+                           print_results=True)
+
+
+def test_global_pooling_gradients():
+    rng = np.random.default_rng(8)
+    b, t, nin, nout = 3, 5, 4, 2
+    x = rng.normal(size=(b, t, nin))
+    y = _onehot(rng.integers(0, nout, b), nout)
+    for pt in ("max", "avg", "sum"):
+        net = _build([GravesLSTM(n_out=4, activation="tanh"),
+                      GlobalPoolingLayer(pooling_type=pt),
+                      OutputLayer(n_out=nout, activation="softmax", loss="MCXENT")],
+                     InputType.recurrent(nin))
+        assert check_gradients(net, x, y, print_results=True), pt
+
+
+def test_embedding_gradients():
+    rng = np.random.default_rng(9)
+    b, vocab, nout = 6, 10, 3
+    x = rng.integers(0, vocab, (b, 1)).astype(np.float64)
+    y = _onehot(rng.integers(0, nout, b), nout)
+    net = _build([EmbeddingLayer(n_in=vocab, n_out=5, activation="identity"),
+                  DenseLayer(n_out=4, activation="tanh"),
+                  OutputLayer(n_out=nout, activation="softmax", loss="MCXENT")],
+                 InputType.feed_forward(1))
+    assert check_gradients(net, x, y, print_results=True)

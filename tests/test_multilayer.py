@@ -1,0 +1,193 @@
+"""MultiLayerNetwork behavior tests (reference:
+deeplearning4j-core/src/test/java/org/deeplearning4j/nn/multilayer/
+MultiLayerTest.java, BackPropMLPTest.java, TestSetGetParameters.java).
+"""
+import os
+
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu import (NeuralNetConfiguration, InputType, DenseLayer,
+                                OutputLayer, RnnOutputLayer, GravesLSTM,
+                                ConvolutionLayer, SubsamplingLayer, DropoutLayer,
+                                MultiLayerNetwork, DataSet, INDArrayDataSetIterator,
+                                ListDataSetIterator, AsyncDataSetIterator,
+                                Adam, Sgd, Nesterovs, WeightInit, BackpropType,
+                                ModelSerializer, ScoreIterationListener,
+                                CollectScoresIterationListener)
+
+
+def _toy_classification(n=256, nin=4, nout=3, seed=0):
+    rng = np.random.default_rng(seed)
+    X = rng.normal(size=(n, nin)).astype(np.float32)
+    w = rng.normal(size=(nin, nout))
+    y = np.argmax(X @ w + 0.1 * rng.normal(size=(n, nout)), axis=1)
+    return X, np.eye(nout, dtype=np.float32)[y]
+
+
+def _mlp_conf(nin=4, nout=3, updater=None):
+    return (NeuralNetConfiguration.builder()
+            .seed(42).updater(updater or Adam(1e-2)).weight_init(WeightInit.XAVIER)
+            .list()
+            .layer(DenseLayer(n_out=16, activation="relu"))
+            .layer(OutputLayer(n_out=nout, activation="softmax", loss="MCXENT"))
+            .input_type(InputType.feed_forward(nin))
+            .build())
+
+
+def test_fit_reduces_score_and_learns():
+    X, Y = _toy_classification()
+    net = MultiLayerNetwork(_mlp_conf()).init()
+    s0 = net.score(DataSet(X, Y))
+    net.fit(INDArrayDataSetIterator(X, Y, 64), epochs=30)
+    s1 = net.score(DataSet(X, Y))
+    assert s1 < s0 * 0.5
+    acc = net.evaluate(ListDataSetIterator([DataSet(X, Y)])).accuracy()
+    assert acc > 0.9
+
+
+def test_updaters_all_train():
+    X, Y = _toy_classification(n=128)
+    from deeplearning4j_tpu import AdaGrad, AdaDelta, RmsProp
+    for upd in (Sgd(0.1), Nesterovs(0.05), Adam(1e-2), AdaGrad(learning_rate=0.1),
+                AdaDelta(), RmsProp(learning_rate=1e-2)):
+        net = MultiLayerNetwork(_mlp_conf(updater=upd)).init()
+        s0 = net.score(DataSet(X, Y))
+        net.fit(INDArrayDataSetIterator(X, Y, 64), epochs=10)
+        assert net.score(DataSet(X, Y)) < s0, type(upd).__name__
+
+
+def test_param_flat_view_roundtrip():
+    """Flattened param view get/set (reference: TestSetGetParameters.java)."""
+    net = MultiLayerNetwork(_mlp_conf()).init()
+    flat = net.get_flat_params()
+    assert flat.size == net.num_params()
+    flat2 = flat * 2.0
+    net.set_flat_params(flat2)
+    np.testing.assert_allclose(net.get_flat_params(), flat2, rtol=1e-6)
+
+
+def test_model_serializer_roundtrip(tmp_path):
+    """Checkpoint zip round-trip (reference: ModelSerializer + regression tests)."""
+    X, Y = _toy_classification(n=64)
+    net = MultiLayerNetwork(_mlp_conf()).init()
+    net.fit(INDArrayDataSetIterator(X, Y, 32), epochs=3)
+    path = str(tmp_path / "model.zip")
+    ModelSerializer.write_model(net, path)
+    net2 = ModelSerializer.restore_multi_layer_network(path)
+    np.testing.assert_allclose(net.get_flat_params(), net2.get_flat_params(), rtol=1e-6)
+    out1 = np.asarray(net.output(X[:8]))
+    out2 = np.asarray(net2.output(X[:8]))
+    np.testing.assert_allclose(out1, out2, rtol=1e-5)
+    # updater state restored: one more identical fit step stays identical
+    ds = DataSet(X[:32], Y[:32])
+    net.fit_batch(ds)
+    net2.fit_batch(ds)
+    np.testing.assert_allclose(net.get_flat_params(), net2.get_flat_params(),
+                               rtol=1e-5, atol=1e-6)
+    # sniffing loader
+    net3 = ModelSerializer.restore(path)
+    assert isinstance(net3, MultiLayerNetwork)
+
+
+def test_listeners():
+    X, Y = _toy_classification(n=64)
+    net = MultiLayerNetwork(_mlp_conf()).init()
+    coll = CollectScoresIterationListener()
+    net.set_listeners(ScoreIterationListener(100, log_fn=lambda s: None), coll)
+    net.fit(INDArrayDataSetIterator(X, Y, 32), epochs=2)
+    assert len(coll.scores) == 4
+
+
+def test_async_iterator_equivalence():
+    X, Y = _toy_classification(n=64)
+    base = INDArrayDataSetIterator(X, Y, 16)
+    async_it = AsyncDataSetIterator(INDArrayDataSetIterator(X, Y, 16))
+    batches_a = [ds.features.shape for ds in base]
+    batches_b = [ds.features.shape for ds in async_it]
+    assert batches_a == batches_b
+    async_it.reset()
+    assert sum(1 for _ in async_it) == 4
+
+
+def test_rnn_fit_and_time_step():
+    """Char-RNN style next-step prediction; streaming rnnTimeStep equals full
+    forward (reference: MultiLayerTestRNN.java)."""
+    rng = np.random.default_rng(0)
+    b, t, f = 4, 8, 5
+    x = rng.normal(size=(b, t, f)).astype(np.float32)
+    y = np.eye(f, dtype=np.float32)[rng.integers(0, f, (b, t))]
+    conf = (NeuralNetConfiguration.builder()
+            .seed(1).updater(Adam(1e-2))
+            .list()
+            .layer(GravesLSTM(n_out=8, activation="tanh"))
+            .layer(RnnOutputLayer(n_out=f, activation="softmax", loss="MCXENT"))
+            .input_type(InputType.recurrent(f))
+            .build())
+    net = MultiLayerNetwork(conf).init()
+    s0 = net.score(DataSet(x, y))
+    net.fit(ListDataSetIterator([DataSet(x, y)]), epochs=20)
+    assert net.score(DataSet(x, y)) < s0
+    # streaming: feed steps one at a time, compare with full output
+    full = np.asarray(net.output(x))
+    net.rnn_clear_previous_state()
+    outs = [np.asarray(net.rnn_time_step(x[:, i])) for i in range(t)]
+    streamed = np.stack(outs, axis=1)
+    np.testing.assert_allclose(full, streamed, rtol=1e-4, atol=1e-5)
+
+
+def test_tbptt_runs():
+    rng = np.random.default_rng(0)
+    b, t, f = 2, 12, 4
+    x = rng.normal(size=(b, t, f)).astype(np.float32)
+    y = np.eye(f, dtype=np.float32)[rng.integers(0, f, (b, t))]
+    conf = (NeuralNetConfiguration.builder()
+            .seed(1).updater(Adam(1e-2))
+            .list()
+            .layer(GravesLSTM(n_out=6, activation="tanh"))
+            .layer(RnnOutputLayer(n_out=f, activation="softmax", loss="MCXENT"))
+            .input_type(InputType.recurrent(f))
+            .backprop_type(BackpropType.TRUNCATED_BPTT)
+            .tbptt_fwd_length(4).tbptt_back_length(4)
+            .build())
+    net = MultiLayerNetwork(conf).init()
+    s0 = net.score(DataSet(x, y))
+    for _ in range(15):
+        net.fit_batch(DataSet(x, y))
+    assert net.score(DataSet(x, y)) < s0
+
+
+def test_dropout_train_vs_inference():
+    X, Y = _toy_classification(n=32)
+    conf = (NeuralNetConfiguration.builder()
+            .seed(7).updater(Sgd(0.1)).dropout(0.5)
+            .list()
+            .layer(DenseLayer(n_out=16, activation="relu"))
+            .layer(OutputLayer(n_out=3, activation="softmax", loss="MCXENT"))
+            .input_type(InputType.feed_forward(4))
+            .build())
+    net = MultiLayerNetwork(conf).init()
+    # inference is deterministic (no dropout)
+    o1 = np.asarray(net.output(X))
+    o2 = np.asarray(net.output(X))
+    np.testing.assert_allclose(o1, o2)
+    net.fit(INDArrayDataSetIterator(X, Y, 16), epochs=2)  # runs with dropout
+
+
+def test_cnn_pipeline_shapes():
+    rng = np.random.default_rng(0)
+    x = rng.normal(size=(2, 12, 12, 1)).astype(np.float32)
+    y = np.eye(3, dtype=np.float32)[rng.integers(0, 3, 2)]
+    conf = (NeuralNetConfiguration.builder()
+            .seed(1).updater(Adam(1e-3))
+            .list()
+            .layer(ConvolutionLayer(kernel_size=(3, 3), n_out=4, activation="relu"))
+            .layer(SubsamplingLayer(kernel_size=(2, 2), stride=(2, 2)))
+            .layer(DenseLayer(n_out=10, activation="relu"))
+            .layer(OutputLayer(n_out=3, activation="softmax", loss="MCXENT"))
+            .input_type(InputType.convolutional(12, 12, 1))
+            .build())
+    net = MultiLayerNetwork(conf).init()
+    out = net.output(x)
+    assert out.shape == (2, 3)
+    net.fit_batch(DataSet(x, y))
