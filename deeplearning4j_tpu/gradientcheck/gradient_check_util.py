@@ -30,17 +30,32 @@ def check_gradients(net, x, y, *, eps=DEFAULT_EPS, max_rel_error=DEFAULT_MAX_REL
 
     Returns True if all checked elements pass.
     """
-    x = jnp.asarray(x, jnp.float64)
-    y = jnp.asarray(y, jnp.float64)
+    is_graph = isinstance(x, (list, tuple))  # ComputationGraph takes input/label lists
+    if is_graph:
+        x = [jnp.asarray(xi, jnp.float64) for xi in x]
+        y = [jnp.asarray(yi, jnp.float64) for yi in y]
+    else:
+        x = jnp.asarray(x, jnp.float64)
+        y = jnp.asarray(y, jnp.float64)
     net.params = jax.tree_util.tree_map(lambda p: jnp.asarray(p, jnp.float64), net.params)
     net.states = jax.tree_util.tree_map(lambda s: jnp.asarray(s, jnp.float64), net.states)
 
     grads, _ = net.compute_gradient_and_score(x, y, mask, label_mask)
 
+    # jit once: every perturbation re-runs the same computation, so tracing
+    # per call dominates wall time (LSTM scans especially)
+    @jax.jit
+    def _score(params):
+        if is_graph:
+            s, _ = net._loss(params, net.states, x, y, train=False, rng=None,
+                             masks=mask, label_masks=label_mask)
+        else:
+            s, _ = net._loss(params, net.states, x, y, train=False, rng=None,
+                             mask=mask, label_mask=label_mask)
+        return s
+
     def score_with(params):
-        s, _ = net._loss(params, net.states, x, y, train=False, rng=None,
-                         mask=mask, label_mask=label_mask)
-        return float(s)
+        return float(_score(params))
 
     rng = np.random.default_rng(seed)
     n_fail = 0
