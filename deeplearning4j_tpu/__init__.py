@@ -31,6 +31,7 @@ from .datasets.iterator.base import (DataSetIterator, ListDataSetIterator,
                                      INDArrayDataSetIterator, AsyncDataSetIterator,
                                      MultipleEpochsIterator, ExistingDataSetIterator)
 from .eval.evaluation import Evaluation
+from .eval.roc import ROC, ROCMultiClass, RegressionEvaluation
 from .optimize.listeners import (ScoreIterationListener, PerformanceListener,
                                  CollectScoresIterationListener)
 

@@ -1,0 +1,56 @@
+"""Model savers for early stopping (reference: earlystopping/saver/ —
+InMemoryModelSaver.java, LocalFileModelSaver.java, LocalFileGraphSaver.java)."""
+from __future__ import annotations
+
+import os
+
+
+class InMemoryModelSaver:
+    def __init__(self):
+        self._best = None
+        self._latest = None
+
+    def save_best_model(self, model, score):
+        self._best = model.clone()
+
+    def save_latest_model(self, model, score):
+        self._latest = model.clone()
+
+    def get_best_model(self):
+        return self._best
+
+    def get_latest_model(self):
+        return self._latest
+
+
+class LocalFileModelSaver:
+    """Persists best/latest model zips in a directory (same filenames as the
+    reference: bestModel.bin, latestModel.bin)."""
+
+    def __init__(self, directory):
+        self.directory = str(directory)
+        os.makedirs(self.directory, exist_ok=True)
+
+    def _path(self, name):
+        return os.path.join(self.directory, name)
+
+    def save_best_model(self, model, score):
+        from ..util.model_serializer import ModelSerializer
+        ModelSerializer.write_model(model, self._path("bestModel.bin"), save_updater=True)
+
+    def save_latest_model(self, model, score):
+        from ..util.model_serializer import ModelSerializer
+        ModelSerializer.write_model(model, self._path("latestModel.bin"), save_updater=True)
+
+    def get_best_model(self):
+        from ..util.model_serializer import ModelSerializer
+        p = self._path("bestModel.bin")
+        return ModelSerializer.restore(p) if os.path.exists(p) else None
+
+    def get_latest_model(self):
+        from ..util.model_serializer import ModelSerializer
+        p = self._path("latestModel.bin")
+        return ModelSerializer.restore(p) if os.path.exists(p) else None
+
+
+LocalFileGraphSaver = LocalFileModelSaver

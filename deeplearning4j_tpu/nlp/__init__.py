@@ -1,0 +1,36 @@
+"""NLP stack (reference: deeplearning4j-nlp-parent, 308 files / 45.8k LoC):
+embeddings (Word2Vec/ParagraphVectors/GloVe), tokenization, vocab/Huffman,
+serialization, count vectorizers, CNN sentence iterator.
+
+See SURVEY.md §2.6. The reference's Hogwild thread parallelism (P7) is
+replaced by device-batched XLA scatter-add training (embeddings.py).
+"""
+from .tokenization import (DefaultTokenizer, NGramTokenizer,
+                           DefaultTokenizerFactory, NGramTokenizerFactory,
+                           CommonPreprocessor, LowCasePreProcessor,
+                           EndingPreProcessor, StopWords)
+from .text import (SentenceIterator, CollectionSentenceIterator,
+                   BasicLineIterator, LineSentenceIterator, FileSentenceIterator,
+                   LabelledDocument, LabelsSource, LabelAwareIterator,
+                   SimpleLabelAwareIterator)
+from .vocab import VocabWord, VocabCache, VocabConstructor, Huffman
+from .embeddings import InMemoryLookupTable, WeightLookupTable
+from .sequence_vectors import SequenceVectors, Word2Vec, ParagraphVectors, WordVectors
+from .glove import Glove
+from .serializer import WordVectorSerializer
+from .bagofwords import BagOfWordsVectorizer, TfidfVectorizer
+from .cnn_sentence import CnnSentenceDataSetIterator
+
+__all__ = [
+    "DefaultTokenizer", "NGramTokenizer", "DefaultTokenizerFactory",
+    "NGramTokenizerFactory", "CommonPreprocessor", "LowCasePreProcessor",
+    "EndingPreProcessor", "StopWords",
+    "SentenceIterator", "CollectionSentenceIterator", "BasicLineIterator",
+    "LineSentenceIterator", "FileSentenceIterator", "LabelledDocument",
+    "LabelsSource", "LabelAwareIterator", "SimpleLabelAwareIterator",
+    "VocabWord", "VocabCache", "VocabConstructor", "Huffman",
+    "InMemoryLookupTable", "WeightLookupTable",
+    "SequenceVectors", "Word2Vec", "ParagraphVectors", "WordVectors", "Glove",
+    "WordVectorSerializer", "BagOfWordsVectorizer", "TfidfVectorizer",
+    "CnnSentenceDataSetIterator",
+]

@@ -1,0 +1,259 @@
+"""Embedding lookup tables + batched XLA learning kernels.
+
+Reference: models/embeddings/inmemory/InMemoryLookupTable.java (734 LoC; syn0,
+syn1 for hierarchical softmax, syn1neg + unigram table for negative sampling,
+expTable) and models/embeddings/learning/impl/elements/{SkipGram.java,
+CBOW.java}.
+
+TPU-first redesign: the reference trains Hogwild-style — N Java threads doing
+lock-free axpy on shared syn0/syn1 rows (SequenceVectors.java:267-271, P7 in
+SURVEY §2.4). Here a training *batch* of (center, context) pairs becomes ONE
+jitted XLA computation: gather rows → sigmoid dot products → scatter-add
+updates (`.at[].add` accumulates duplicate indices, which is exactly the
+sequential-consistency Hogwild approximates). Negative sampling draws from the
+unigram^0.75 table on device via jax.random.
+"""
+from __future__ import annotations
+
+import functools
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+
+class WeightLookupTable:
+    """API surface of the reference's WeightLookupTable.java."""
+
+    def vector(self, word):
+        raise NotImplementedError
+
+    def layer_size(self):
+        raise NotImplementedError
+
+
+class InMemoryLookupTable(WeightLookupTable):
+    def __init__(self, vocab, vector_length=100, seed=12345, negative=5,
+                 use_hs=False, dtype=jnp.float32):
+        self.vocab = vocab
+        self.vector_length = int(vector_length)
+        self.seed = seed
+        self.negative = int(negative)
+        self.use_hs = use_hs
+        self.dtype = dtype
+        self.syn0 = None
+        self.syn1 = None       # HS inner-node weights
+        self.syn1neg = None    # negative-sampling output weights
+        self._unigram = None   # int32 sampling table (word2vec unigram^0.75)
+
+    def reset_weights(self, n_extra_rows=0):
+        """syn0 ~ U(-0.5,0.5)/dim like word2vec; syn1/syn1neg zeros.
+        n_extra_rows reserves label rows for ParagraphVectors."""
+        v = self.vocab.num_words() + n_extra_rows
+        d = self.vector_length
+        key = jax.random.PRNGKey(self.seed)
+        self.syn0 = (jax.random.uniform(key, (v, d), self.dtype) - 0.5) / d
+        self.syn1 = jnp.zeros((max(v - 1, 1), d), self.dtype)
+        self.syn1neg = jnp.zeros((v, d), self.dtype)
+        self._build_unigram_table()
+        return self
+
+    def _build_unigram_table(self, table_size=1_000_000, power=0.75):
+        """word2vec-style unigram table (reference: InMemoryLookupTable
+        makeTable)."""
+        counts = np.array([w.count for w in self.vocab.vocab_words()], np.float64)
+        if counts.size == 0:
+            self._unigram = jnp.zeros((1,), jnp.int32)
+            return
+        probs = counts ** power
+        probs /= probs.sum()
+        table = np.repeat(np.arange(len(counts)),
+                          np.maximum(1, np.round(probs * table_size).astype(int)))
+        self._unigram = jnp.asarray(table, jnp.int32)
+
+    # ------------------------------------------------------------- access
+    def layer_size(self):
+        return self.vector_length
+
+    def vector(self, word):
+        idx = self.vocab.index_of(word)
+        if idx < 0:
+            return None
+        return np.asarray(self.syn0[idx])
+
+    def get_weights(self):
+        return np.asarray(self.syn0[: self.vocab.num_words()])
+
+
+# ------------------------------------------------------------ XLA kernels
+#
+# Batching note: the reference applies each pair's update sequentially
+# (Hogwild, SequenceVectors.java:267-271). Summing a whole batch of updates
+# computed at stale weights diverges when rows repeat many times per batch
+# (small vocab); a pure scatter-mean is stable but gives each row only one
+# effective update per batch. The middle ground used here: lax.scan over
+# fixed-size CHUNKS of pairs — within a chunk updates are scatter-MEANed
+# (stable), between chunks weights refresh (sequential-like convergence).
+# One jitted XLA computation per batch either way.
+
+CHUNK = 128
+
+
+def _inv_counts(size, idx, weights=None):
+    """1/max(count,1) per table row, gathered back for scatter-mean scaling."""
+    ones = jnp.ones(idx.shape, jnp.float32) if weights is None else weights
+    cnt = jnp.zeros((size,), jnp.float32).at[idx].add(ones)
+    return 1.0 / jnp.maximum(cnt, 1.0)
+
+
+def _chunked(*arrays):
+    """Reshape [B,...] arrays to [S, CHUNK, ...] for lax.scan."""
+    out = []
+    for a in arrays:
+        out.append(a.reshape((-1, CHUNK) + a.shape[1:]))
+    return out
+
+
+@functools.partial(jax.jit, donate_argnums=(0, 1), static_argnames=("n_neg",))
+def skipgram_ns_step(syn0, syn1neg, unigram, centers, contexts, valid, lr, key,
+                     n_neg):
+    """Skip-gram negative sampling (reference: SkipGram.java iterateSample,
+    negative-sampling branch). centers/contexts: int32[B] padded to a multiple
+    of CHUNK; valid: float32[B] 0/1 pair validity."""
+    B = centers.shape[0]
+    d = syn0.shape[1]
+    negs = unigram[jax.random.randint(key, (B, n_neg), 0, unigram.shape[0])]
+    cs, os_, vs, ns = _chunked(centers, contexts, valid, negs)
+
+    def body(carry, args):
+        syn0, syn1neg = carry
+        c, o, val, neg = args
+        v = syn0[c]                                     # C,D
+        uo = syn1neg[o]                                 # C,D
+        un = syn1neg[neg]                               # C,K,D
+        pos_f = jax.nn.sigmoid(jnp.sum(v * uo, -1))
+        g_pos = (1.0 - pos_f) * lr * val
+        neg_f = jax.nn.sigmoid(jnp.einsum("bd,bkd->bk", v, un))
+        # word2vec skips a negative that equals the positive target word
+        not_target = (neg != o[:, None]).astype(jnp.float32)
+        g_neg = -neg_f * lr * val[:, None] * not_target  # label 0
+        dv = g_pos[:, None] * uo + jnp.einsum("bk,bkd->bd", g_neg, un)
+        duo = g_pos[:, None] * v
+        dun = (g_neg[..., None] * v[:, None, :]).reshape(-1, d)
+        neg_flat = neg.reshape(-1)
+        inv0 = _inv_counts(syn0.shape[0], c, val)
+        inv1 = _inv_counts(syn1neg.shape[0], jnp.concatenate([o, neg_flat]))
+        syn0 = syn0.at[c].add(dv * inv0[c][:, None])
+        syn1neg = syn1neg.at[o].add(duo * inv1[o][:, None])
+        syn1neg = syn1neg.at[neg_flat].add(dun * inv1[neg_flat][:, None])
+        return (syn0, syn1neg), None
+
+    (syn0, syn1neg), _ = jax.lax.scan(body, (syn0, syn1neg), (cs, os_, vs, ns))
+    return syn0, syn1neg
+
+
+@functools.partial(jax.jit, donate_argnums=(0, 1))
+def skipgram_hs_step(syn0, syn1, centers, codes, points, mask, valid, lr):
+    """Hierarchical-softmax branch (reference: SkipGram.java iterateSample HS
+    loop). codes/points/mask: [B, L] padded to max code length."""
+    d = syn0.shape[1]
+    cs, cds, pts, ms, vs = _chunked(centers, codes, points, mask, valid)
+
+    def body(carry, args):
+        syn0, syn1 = carry
+        c, code, point, m, val = args
+        m = m * val[:, None]
+        v = syn0[c]                                     # C,D
+        u = syn1[point]                                 # C,L,D
+        f = jax.nn.sigmoid(jnp.einsum("bd,bld->bl", v, u))
+        g = (1.0 - code - f) * lr * m                   # word2vec HS gradient
+        dv = jnp.einsum("bl,bld->bd", g, u)
+        du = (g[..., None] * v[:, None, :]).reshape(-1, d)
+        pts_flat = point.reshape(-1)
+        inv0 = _inv_counts(syn0.shape[0], c, val)
+        inv1 = _inv_counts(syn1.shape[0], pts_flat, m.reshape(-1))
+        syn0 = syn0.at[c].add(dv * inv0[c][:, None])
+        syn1 = syn1.at[pts_flat].add(du * inv1[pts_flat][:, None])
+        return (syn0, syn1), None
+
+    (syn0, syn1), _ = jax.lax.scan(body, (syn0, syn1), (cs, cds, pts, ms, vs))
+    return syn0, syn1
+
+
+@functools.partial(jax.jit, donate_argnums=(0, 1), static_argnames=("n_neg",))
+def cbow_ns_step(syn0, syn1neg, unigram, context_idx, context_mask, centers,
+                 valid, lr, key, n_neg):
+    """CBOW negative sampling (reference: CBOW.java — mean of window vectors
+    predicts the center; gradient spread back over the window).
+    context_idx: int32[B, W] (padded), context_mask: [B, W]."""
+    B, W = context_idx.shape
+    d = syn0.shape[1]
+    negs = unigram[jax.random.randint(key, (B, n_neg), 0, unigram.shape[0])]
+    ctxs, cms, cs, vs, ns = _chunked(context_idx, context_mask, centers, valid,
+                                     negs)
+
+    def body(carry, args):
+        syn0, syn1neg = carry
+        context_idx, context_mask, centers, val, neg = args
+        context_mask = context_mask * val[:, None]
+        ctx = syn0[context_idx]                         # C,W,D
+        denom = jnp.maximum(context_mask.sum(-1, keepdims=True), 1.0)
+        h = jnp.einsum("bwd,bw->bd", ctx, context_mask) / denom
+        uo = syn1neg[centers]
+        un = syn1neg[neg]
+        pos_f = jax.nn.sigmoid(jnp.sum(h * uo, -1))
+        g_pos = (1.0 - pos_f) * lr * val
+        neg_f = jax.nn.sigmoid(jnp.einsum("bd,bkd->bk", h, un))
+        # word2vec skips a negative that equals the positive target word
+        not_target = (neg != centers[:, None]).astype(jnp.float32)
+        g_neg = -neg_f * lr * val[:, None] * not_target
+        dh = g_pos[:, None] * uo + jnp.einsum("bk,bkd->bd", g_neg, un)
+        duo = g_pos[:, None] * h
+        dun = (g_neg[..., None] * h[:, None, :]).reshape(-1, d)
+        dctx = ((dh / denom)[:, None, :] * context_mask[..., None]).reshape(-1, d)
+        ctx_flat = context_idx.reshape(-1)
+        neg_flat = neg.reshape(-1)
+        inv0 = _inv_counts(syn0.shape[0], ctx_flat, context_mask.reshape(-1))
+        inv1 = _inv_counts(syn1neg.shape[0], jnp.concatenate([centers, neg_flat]))
+        syn0 = syn0.at[ctx_flat].add(dctx * inv0[ctx_flat][:, None])
+        syn1neg = syn1neg.at[centers].add(duo * inv1[centers][:, None])
+        syn1neg = syn1neg.at[neg_flat].add(dun * inv1[neg_flat][:, None])
+        return (syn0, syn1neg), None
+
+    (syn0, syn1neg), _ = jax.lax.scan(body, (syn0, syn1neg),
+                                      (ctxs, cms, cs, vs, ns))
+    return syn0, syn1neg
+
+
+@functools.partial(jax.jit, donate_argnums=(0, 1))
+def cbow_hs_step(syn0, syn1, context_idx, context_mask, codes, points, mask,
+                 valid, lr):
+    d = syn0.shape[1]
+    ctxs, cms, cds, pts, ms, vs = _chunked(context_idx, context_mask, codes,
+                                           points, mask, valid)
+
+    def body(carry, args):
+        syn0, syn1 = carry
+        context_idx, context_mask, code, point, m, val = args
+        context_mask = context_mask * val[:, None]
+        m = m * val[:, None]
+        ctx = syn0[context_idx]
+        denom = jnp.maximum(context_mask.sum(-1, keepdims=True), 1.0)
+        h = jnp.einsum("bwd,bw->bd", ctx, context_mask) / denom
+        u = syn1[point]
+        f = jax.nn.sigmoid(jnp.einsum("bd,bld->bl", h, u))
+        g = (1.0 - code - f) * lr * m
+        dh = jnp.einsum("bl,bld->bd", g, u)
+        du = (g[..., None] * h[:, None, :]).reshape(-1, d)
+        dctx = ((dh / denom)[:, None, :] * context_mask[..., None]).reshape(-1, d)
+        ctx_flat = context_idx.reshape(-1)
+        pts_flat = point.reshape(-1)
+        inv0 = _inv_counts(syn0.shape[0], ctx_flat, context_mask.reshape(-1))
+        inv1 = _inv_counts(syn1.shape[0], pts_flat, m.reshape(-1))
+        syn0 = syn0.at[ctx_flat].add(dctx * inv0[ctx_flat][:, None])
+        syn1 = syn1.at[pts_flat].add(du * inv1[pts_flat][:, None])
+        return (syn0, syn1), None
+
+    (syn0, syn1), _ = jax.lax.scan(body, (syn0, syn1),
+                                   (ctxs, cms, cds, pts, ms, vs))
+    return syn0, syn1

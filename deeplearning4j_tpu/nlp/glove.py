@@ -1,0 +1,170 @@
+"""GloVe embeddings.
+
+Reference: models/glove/Glove.java (438 LoC) + models/glove/count/ —
+co-occurrence counting with 1/distance weighting, then AdaGrad-optimized
+weighted-least-squares on log co-occurrence.
+
+TPU redesign: co-occurrence counting on host (hash map, like the reference's
+count package), training as batched jitted steps over the co-occurrence
+triples: per batch gather word/context rows + biases, compute
+f(X)(w·w̃ + b + b̃ − log X) gradients, AdaGrad scale, scatter-add back.
+"""
+from __future__ import annotations
+
+import functools
+from collections import defaultdict
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from .vocab import VocabConstructor
+from .sequence_vectors import WordVectors
+from .embeddings import InMemoryLookupTable
+from .tokenization import DefaultTokenizerFactory
+
+
+@functools.partial(jax.jit, donate_argnums=(0, 1, 2, 3, 4, 5))
+def _glove_step(W, Wc, b, bc, hW, hWc, hb, hbc, wi, ci, logx, fx, lr):
+    """AdaGrad GloVe update on a batch of (word, ctx, log co-occurrence,
+    weight) triples."""
+    d = W.shape[1]
+    w = W[wi]
+    c = Wc[ci]
+    diff = jnp.sum(w * c, -1) + b[wi] + bc[ci] - logx       # B
+    g = fx * diff                                            # B
+    gw = g[:, None] * c
+    gc = g[:, None] * w
+    # adagrad accumulators
+    hW = hW.at[wi].add(gw ** 2)
+    hWc = hWc.at[ci].add(gc ** 2)
+    hb = hb.at[wi].add(g ** 2)
+    hbc = hbc.at[ci].add(g ** 2)
+    W = W.at[wi].add(-lr * gw / jnp.sqrt(hW[wi] + 1e-8))
+    Wc = Wc.at[ci].add(-lr * gc / jnp.sqrt(hWc[ci] + 1e-8))
+    b = b.at[wi].add(-lr * g / jnp.sqrt(hb[wi] + 1e-8))
+    bc = bc.at[ci].add(-lr * g / jnp.sqrt(hbc[ci] + 1e-8))
+    loss = 0.5 * jnp.sum(fx * diff ** 2)
+    return W, Wc, b, bc, hW, hWc, hb, hbc, loss
+
+
+class Glove(WordVectors):
+    def __init__(self, *, layer_size=100, window=5, learning_rate=0.05,
+                 epochs=5, min_word_frequency=1, x_max=100.0, alpha=0.75,
+                 seed=12345, batch_size=8192, tokenizer_factory=None,
+                 symmetric=True):
+        self.layer_size = layer_size
+        self.window = window
+        self.learning_rate = learning_rate
+        self.epochs = epochs
+        self.min_word_frequency = min_word_frequency
+        self.x_max = x_max
+        self.alpha = alpha
+        self.seed = seed
+        self.batch_size = batch_size
+        self.symmetric = symmetric
+        self.tokenizer_factory = tokenizer_factory or DefaultTokenizerFactory()
+        self.vocab = None
+        self.lookup_table = None
+        self.loss_history = []
+
+    class Builder:
+        def __init__(self):
+            self._kw = {}
+
+        def layer_size(self, n):
+            self._kw["layer_size"] = n
+            return self
+
+        def window_size(self, n):
+            self._kw["window"] = n
+            return self
+
+        def learning_rate(self, lr):
+            self._kw["learning_rate"] = lr
+            return self
+
+        def epochs(self, n):
+            self._kw["epochs"] = n
+            return self
+
+        def min_word_frequency(self, n):
+            self._kw["min_word_frequency"] = n
+            return self
+
+        def x_max(self, x):
+            self._kw["x_max"] = x
+            return self
+
+        def seed(self, s):
+            self._kw["seed"] = s
+            return self
+
+        def build(self):
+            return Glove(**self._kw)
+
+    @staticmethod
+    def builder():
+        return Glove.Builder()
+
+    def _cooccurrence(self, sentences):
+        """(reference: glove/count/ — 1/distance-weighted counts)"""
+        counts = defaultdict(float)
+        for s in sentences:
+            toks = self.tokenizer_factory.create(s).get_tokens()
+            idxs = [self.vocab.index_of(t) for t in toks]
+            idxs = [i for i in idxs if i >= 0]
+            for i, wi in enumerate(idxs):
+                for j in range(max(0, i - self.window), i):
+                    ci = idxs[j]
+                    weight = 1.0 / (i - j)
+                    counts[(wi, ci)] += weight
+                    if self.symmetric:
+                        counts[(ci, wi)] += weight
+        return counts
+
+    def fit(self, sentences):
+        sentences = list(sentences)
+        self.vocab = VocabConstructor(
+            self.tokenizer_factory,
+            self.min_word_frequency).build_vocab(sentences, build_huffman=False)
+        V, D = self.vocab.num_words(), self.layer_size
+        counts = self._cooccurrence(sentences)
+        triples = np.array([(w, c, x) for (w, c), x in counts.items()],
+                           np.float64).reshape(-1, 3)
+        wi_all = triples[:, 0].astype(np.int32)
+        ci_all = triples[:, 1].astype(np.int32)
+        x_all = triples[:, 2]
+        logx_all = np.log(x_all).astype(np.float32)
+        fx_all = np.minimum(1.0, (x_all / self.x_max) ** self.alpha).astype(np.float32)
+
+        key = jax.random.PRNGKey(self.seed)
+        k1, k2 = jax.random.split(key)
+        W = (jax.random.uniform(k1, (V, D)) - 0.5) / D
+        Wc = (jax.random.uniform(k2, (V, D)) - 0.5) / D
+        b = jnp.zeros((V,))
+        bc = jnp.zeros((V,))
+        hW, hWc = jnp.zeros((V, D)), jnp.zeros((V, D))
+        hb, hbc = jnp.zeros((V,)), jnp.zeros((V,))
+
+        n = len(wi_all)
+        rng = np.random.default_rng(self.seed)
+        for _ in range(self.epochs):
+            order = rng.permutation(n)
+            total = 0.0
+            for s in range(0, n, self.batch_size):
+                sel = order[s:s + self.batch_size]
+                W, Wc, b, bc, hW, hWc, hb, hbc, loss = _glove_step(
+                    W, Wc, b, bc, hW, hWc, hb, hbc,
+                    jnp.asarray(wi_all[sel]), jnp.asarray(ci_all[sel]),
+                    jnp.asarray(logx_all[sel]), jnp.asarray(fx_all[sel]),
+                    jnp.float32(self.learning_rate))
+                total += float(loss)
+            self.loss_history.append(total / max(n, 1))
+
+        # final vectors = W + Wc (standard GloVe)
+        self.lookup_table = InMemoryLookupTable(self.vocab, D, self.seed, 0)
+        self.lookup_table.syn0 = W + Wc
+        self.lookup_table.syn1 = jnp.zeros((1, D))
+        self.lookup_table.syn1neg = jnp.zeros((V, D))
+        return self

@@ -309,6 +309,8 @@ class ComputationGraphConfiguration:
     tbptt_back_length: int = 20
     seed: int = 12345
     dtype: str = "float32"
+    optimization_algo: str = "sgd"
+    max_num_line_search_iterations: int = 5
     topological_order: list = None
 
     def topo_sort(self):
@@ -356,6 +358,8 @@ class ComputationGraphConfiguration:
             "tbptt_back_length": self.tbptt_back_length,
             "seed": self.seed,
             "dtype": self.dtype,
+            "optimization_algo": self.optimization_algo,
+            "max_num_line_search_iterations": self.max_num_line_search_iterations,
         }
 
     def to_json(self):
@@ -375,7 +379,8 @@ class ComputationGraphConfiguration:
         conf.network_outputs = list(d["network_outputs"])
         if d.get("input_types"):
             conf.input_types = [InputType.from_dict(t) for t in d["input_types"]]
-        for k in ("backprop_type", "tbptt_fwd_length", "tbptt_back_length", "seed", "dtype"):
+        for k in ("backprop_type", "tbptt_fwd_length", "tbptt_back_length", "seed",
+                  "dtype", "optimization_algo", "max_num_line_search_iterations"):
             if k in d:
                 setattr(conf, k, d[k])
         return conf
@@ -390,8 +395,12 @@ class GraphBuilder:
 
     def __init__(self, global_conf):
         self._global = global_conf
-        self._conf = ComputationGraphConfiguration(seed=global_conf.get("seed", 12345),
-                                                   dtype=global_conf.get("dtype", "float32"))
+        self._conf = ComputationGraphConfiguration(
+            seed=global_conf.get("seed", 12345),
+            dtype=global_conf.get("dtype", "float32"),
+            optimization_algo=global_conf.get("optimization_algo", "sgd"),
+            max_num_line_search_iterations=global_conf.get(
+                "max_num_line_search_iterations", 5))
 
     def add_inputs(self, *names):
         for n in names:

@@ -261,6 +261,19 @@ class ComputationGraph:
         lmasks = None if ds.labels_masks is None else \
             [None if m is None else jnp.asarray(m, self._dtype) for m in ds.labels_masks]
         self._rng, step_rng = jax.random.split(self._rng)
+        from ..conf.configuration import OptimizationAlgorithm
+        if self.conf.optimization_algo != OptimizationAlgorithm.STOCHASTIC_GRADIENT_DESCENT:
+            # flat solvers (reference: Solver.java:55); cached per model
+            if getattr(self, "_flat_solver", None) is None:
+                from ...optimize.solvers import make_solver
+                self._flat_solver = make_solver(
+                    self.conf.optimization_algo, self,
+                    line_search_iterations=self.conf.max_num_line_search_iterations)
+            self._flat_solver.optimize(inputs, labels, masks, lmasks)
+            self.iteration_count += 1
+            for listener in self.listeners:
+                listener.iteration_done(self, self.iteration_count)
+            return
         key = ("train", masks is None, lmasks is None)
         if key not in self._jit_cache:
             self._jit_cache[key] = self._make_train_step()

@@ -231,7 +231,18 @@ class MultiLayerNetwork:
         lmask = None if ds.labels_mask is None else jnp.asarray(ds.labels_mask, self._dtype)
         self._rng, step_rng = jax.random.split(self._rng)
 
-        if (self.conf.backprop_type == BackpropType.TRUNCATED_BPTT and x.ndim == 3
+        from ..conf.configuration import OptimizationAlgorithm
+        if self.conf.optimization_algo != OptimizationAlgorithm.STOCHASTIC_GRADIENT_DESCENT:
+            # second-order / line-search solvers work on the flattened param
+            # vector (reference: Solver.java:55 factory on OptimizationAlgorithm);
+            # one solver instance per model so its compiled fns are reused
+            if getattr(self, "_flat_solver", None) is None:
+                from ...optimize.solvers import make_solver
+                self._flat_solver = make_solver(
+                    self.conf.optimization_algo, self,
+                    line_search_iterations=self.conf.max_num_line_search_iterations)
+            self._flat_solver.optimize(x, y, mask, lmask)
+        elif (self.conf.backprop_type == BackpropType.TRUNCATED_BPTT and x.ndim == 3
                 and x.shape[1] > self.conf.tbptt_fwd_length):
             self._fit_tbptt(x, y, mask, lmask, step_rng)
         else:

@@ -1,0 +1,241 @@
+"""Solvers: line search, conjugate gradient, LBFGS.
+
+Reference: optimize/Solver.java:48 (optimize()) + :55 (factory dispatching on
+OptimizationAlgorithm), optimize/solvers/{StochasticGradientDescent.java:51-72,
+BaseOptimizer.java, BackTrackLineSearch.java, ConjugateGradient.java, LBFGS.java,
+LineGradientDescent.java}.
+
+TPU-first design: instead of the reference's per-op Java loops, each solver
+works on ONE flattened parameter vector; loss+gradient for a minibatch is a
+single jitted XLA computation reused across line-search probes (probes only
+re-run the compiled executable with a new vector — no retrace).
+"""
+from __future__ import annotations
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+
+def _flatten_spec(params):
+    leaves, treedef = jax.tree_util.tree_flatten(params)
+    shapes = [l.shape for l in leaves]
+    sizes = [int(np.prod(s)) if s else 1 for s in shapes]
+    return treedef, shapes, sizes
+
+
+def _ravel(params):
+    leaves = jax.tree_util.tree_leaves(params)
+    return jnp.concatenate([jnp.ravel(l) for l in leaves]) if leaves else jnp.zeros((0,))
+
+
+def _unravel(vec, treedef, shapes, sizes):
+    out, off = [], 0
+    for shape, size in zip(shapes, sizes):
+        out.append(jnp.reshape(vec[off:off + size], shape))
+        off += size
+    return jax.tree_util.tree_unflatten(treedef, out)
+
+
+class BackTrackLineSearch:
+    """Backtracking line search with Armijo sufficient-decrease
+    (reference: optimize/solvers/BackTrackLineSearch.java)."""
+
+    def __init__(self, score_fn, max_iterations=5, c1=1e-4, rho=0.5):
+        self.score_fn = score_fn          # vec -> score (compiled)
+        self.max_iterations = int(max_iterations)
+        self.c1 = c1
+        self.rho = rho
+
+    def optimize(self, w, f0, g, direction, initial_step=1.0):
+        """Returns step size along `direction` satisfying sufficient decrease
+        (0.0 if none found)."""
+        slope = float(jnp.vdot(g, direction))
+        if slope >= 0:   # not a descent direction — reject
+            return 0.0
+        step = initial_step
+        for _ in range(self.max_iterations):
+            f_new = float(self.score_fn(w + step * direction))
+            if np.isfinite(f_new) and f_new <= f0 + self.c1 * step * slope:
+                return step
+            step *= self.rho
+        return 0.0
+
+
+def _shapes_key(x, y):
+    def one(v):
+        if isinstance(v, (list, tuple)):
+            return tuple(tuple(vv.shape) for vv in v)
+        return tuple(v.shape)
+    return (one(x), one(y))
+
+
+class BaseFlatSolver:
+    """Shared machinery: compiled (score, grad) on flattened params.
+
+    Line-search probes run with train=False so the objective is deterministic
+    (no dropout); after the optimization loop one train=True pass refreshes
+    layer states (BatchNorm running statistics) — the reference's CG/LBFGS
+    equally runs its line searches on a fixed objective per iteration.
+    Compiled fns are cached per input shape, so repeated fit_batch calls
+    reuse the same XLA executables.
+    """
+
+    def __init__(self, model, max_iterations=1, line_search_iterations=5):
+        self.model = model
+        self.max_iterations = int(max_iterations)
+        self.line_search_iterations = int(line_search_iterations)
+        self._fns_cache = {}
+
+    def _call_loss(self, p, states, x, y, mask, label_mask, train):
+        is_graph = isinstance(x, (list, tuple))
+        if is_graph:
+            return self.model._loss(p, states, x, y, train=train, rng=None,
+                                    masks=mask, label_masks=label_mask)
+        return self.model._loss(p, states, x, y, train=train, rng=None,
+                                mask=mask, label_mask=label_mask)
+
+    def _fns(self, x, y, mask, label_mask):
+        key = _shapes_key(x, y)
+        treedef, shapes, sizes = _flatten_spec(self.model.params)
+        if key in self._fns_cache:
+            return (treedef, shapes, sizes), *self._fns_cache[key]
+        states = self.model.states
+
+        def loss_vec(vec, x, y):
+            p = _unravel(vec, treedef, shapes, sizes)
+            s, _ = self._call_loss(p, states, x, y, mask, label_mask, False)
+            return s
+
+        vg = jax.jit(jax.value_and_grad(loss_vec))
+        score = jax.jit(loss_vec)
+        vg_b = lambda w: vg(w, x, y)
+        score_b = lambda w: score(w, x, y)
+        self._fns_cache[key] = (vg_b, score_b)
+        return (treedef, shapes, sizes), vg_b, score_b
+
+    def optimize(self, x, y, mask=None, label_mask=None):
+        raise NotImplementedError
+
+    def _finish(self, w, spec, score, x=None, y=None, mask=None, label_mask=None):
+        treedef, shapes, sizes = spec
+        params = jax.tree_util.tree_map(
+            jnp.asarray, _unravel(w, treedef, shapes, sizes))
+        self.model.params = params
+        if x is not None:
+            # one train-mode pass to refresh BN running stats etc.
+            _, aux = self._call_loss(params, self.model.states, x, y, mask,
+                                     label_mask, True)
+            self.model.states = aux[0]
+        self.model.score_value = float(score)
+
+
+class LineGradientDescent(BaseFlatSolver):
+    """Steepest descent with line search (reference: LineGradientDescent.java)."""
+
+    def optimize(self, x, y, mask=None, label_mask=None):
+        spec, vg, score_fn = self._fns(x, y, mask, label_mask)
+        w = _ravel(self.model.params)
+        ls = BackTrackLineSearch(score_fn, self.line_search_iterations)
+        for _ in range(self.max_iterations):
+            f, g = vg(w)
+            step = ls.optimize(w, float(f), g, -g)
+            if step == 0.0:
+                break
+            w = w - step * g
+        self._finish(w, spec, score_fn(w), x, y, mask, label_mask)
+        return self.model
+
+
+class ConjugateGradient(BaseFlatSolver):
+    """Nonlinear CG (Polak-Ribiere+) with restart on non-descent
+    (reference: optimize/solvers/ConjugateGradient.java)."""
+
+    def optimize(self, x, y, mask=None, label_mask=None):
+        spec, vg, score_fn = self._fns(x, y, mask, label_mask)
+        w = _ravel(self.model.params)
+        ls = BackTrackLineSearch(score_fn, self.line_search_iterations)
+        g_prev = None
+        d = None
+        for _ in range(self.max_iterations):
+            f, g = vg(w)
+            if g_prev is None:
+                d = -g
+            else:
+                beta = float(jnp.vdot(g, g - g_prev) / jnp.vdot(g_prev, g_prev))
+                beta = max(0.0, beta)  # PR+ restart
+                d = -g + beta * d
+            step = ls.optimize(w, float(f), g, d)
+            if step == 0.0:
+                # restart with steepest descent once before giving up
+                d = -g
+                step = ls.optimize(w, float(f), g, d)
+                if step == 0.0:
+                    break
+            w = w + step * d
+            g_prev = g
+        self._finish(w, spec, score_fn(w), x, y, mask, label_mask)
+        return self.model
+
+
+class LBFGS(BaseFlatSolver):
+    """Limited-memory BFGS, two-loop recursion (reference:
+    optimize/solvers/LBFGS.java; memory m=4 like the reference default)."""
+
+    def __init__(self, model, max_iterations=1, line_search_iterations=5, m=4):
+        super().__init__(model, max_iterations, line_search_iterations)
+        self.m = int(m)
+
+    def optimize(self, x, y, mask=None, label_mask=None):
+        spec, vg, score_fn = self._fns(x, y, mask, label_mask)
+        w = _ravel(self.model.params)
+        ls = BackTrackLineSearch(score_fn, self.line_search_iterations)
+        s_hist, y_hist = [], []
+        f, g = vg(w)
+        for _ in range(self.max_iterations):
+            # two-loop recursion
+            q = g
+            alphas = []
+            for s, yv in zip(reversed(s_hist), reversed(y_hist)):
+                rho = 1.0 / float(jnp.vdot(yv, s))
+                a = rho * float(jnp.vdot(s, q))
+                alphas.append((a, rho, s, yv))
+                q = q - a * yv
+            if y_hist:
+                gamma = float(jnp.vdot(s_hist[-1], y_hist[-1]) /
+                              jnp.vdot(y_hist[-1], y_hist[-1]))
+                q = gamma * q
+            for a, rho, s, yv in reversed(alphas):
+                b = rho * float(jnp.vdot(yv, q))
+                q = q + (a - b) * s
+            d = -q
+            step = ls.optimize(w, float(f), g, d)
+            if step == 0.0:
+                d = -g
+                step = ls.optimize(w, float(f), g, d)
+                if step == 0.0:
+                    break
+            w_new = w + step * d
+            f_new, g_new = vg(w_new)
+            s_hist.append(w_new - w)
+            y_hist.append(g_new - g)
+            if len(s_hist) > self.m:
+                s_hist.pop(0)
+                y_hist.pop(0)
+            w, f, g = w_new, f_new, g_new
+        self._finish(w, spec, f, x, y, mask, label_mask)
+        return self.model
+
+
+def make_solver(algo, model, max_iterations=1, line_search_iterations=5):
+    """Factory (reference: optimize/Solver.java:55)."""
+    from ...nn.conf.configuration import OptimizationAlgorithm as OA
+    table = {
+        OA.LINE_GRADIENT_DESCENT: LineGradientDescent,
+        OA.CONJUGATE_GRADIENT: ConjugateGradient,
+        OA.LBFGS: LBFGS,
+    }
+    if algo not in table:
+        raise ValueError(f"no flat solver for {algo}")
+    return table[algo](model, max_iterations=max_iterations,
+                       line_search_iterations=line_search_iterations)

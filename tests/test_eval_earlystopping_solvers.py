@@ -1,0 +1,248 @@
+"""Tests for evaluation (ROC/regression), early stopping, and second-order
+solvers — mirroring the reference's EvalTest/ROCTest, EarlyStoppingTest*, and
+TestOptimizers suites under deeplearning4j-core/src/test."""
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu import (NeuralNetConfiguration, InputType, DenseLayer,
+                                OutputLayer, MultiLayerNetwork, Sgd, Adam,
+                                ROC, ROCMultiClass, RegressionEvaluation, DataSet,
+                                ListDataSetIterator)
+from deeplearning4j_tpu.nn.conf.configuration import OptimizationAlgorithm
+from deeplearning4j_tpu.earlystopping import (
+    EarlyStoppingConfiguration, EarlyStoppingTrainer, MaxEpochsTerminationCondition,
+    ScoreImprovementEpochTerminationCondition, MaxTimeIterationTerminationCondition,
+    InvalidScoreIterationTerminationCondition, MaxScoreIterationTerminationCondition,
+    DataSetLossCalculator, InMemoryModelSaver, LocalFileModelSaver,
+    TerminationReason)
+
+
+# ------------------------------------------------------------------- ROC
+
+def test_roc_perfect_classifier():
+    roc = ROC(threshold_steps=50)
+    labels = np.array([[1, 0], [1, 0], [0, 1], [0, 1]], float)
+    # perfectly separable probabilities
+    preds = np.array([[0.9, 0.1], [0.8, 0.2], [0.1, 0.9], [0.2, 0.8]], float)
+    roc.eval(labels, preds)
+    assert roc.calculate_auc() == pytest.approx(1.0)
+
+
+def test_roc_random_classifier():
+    rng = np.random.default_rng(0)
+    n = 4000
+    lab = rng.integers(0, 2, n)
+    labels = np.eye(2)[lab]
+    p1 = rng.random(n)                      # scores independent of label
+    preds = np.stack([1 - p1, p1], axis=1)
+    roc = ROC(threshold_steps=100)
+    roc.eval(labels, preds)
+    assert roc.calculate_auc() == pytest.approx(0.5, abs=0.05)
+
+
+def test_roc_multiclass():
+    rng = np.random.default_rng(1)
+    n, c = 300, 3
+    lab = rng.integers(0, c, n)
+    labels = np.eye(c)[lab]
+    logits = labels * 3 + rng.normal(size=(n, c))
+    preds = np.exp(logits) / np.exp(logits).sum(1, keepdims=True)
+    m = ROCMultiClass(threshold_steps=60)
+    m.eval(labels, preds)
+    assert m.calculate_average_auc() > 0.9
+    assert 0 <= m.calculate_auc(0) <= 1
+
+
+def test_regression_evaluation():
+    rng = np.random.default_rng(2)
+    y = rng.normal(size=(200, 2))
+    pred = y + 0.1 * rng.normal(size=(200, 2))
+    e = RegressionEvaluation(n_columns=2)
+    e.eval(y, pred)
+    assert e.mean_squared_error(0) == pytest.approx(0.01, rel=0.5)
+    assert e.average_r_squared() > 0.9
+    assert e.pearson_correlation(1) > 0.9
+    assert "MSE" in e.stats()
+
+
+def test_regression_evaluation_merge():
+    rng = np.random.default_rng(3)
+    y1, y2 = rng.normal(size=(50, 1)), rng.normal(size=(50, 1))
+    e1, e2 = RegressionEvaluation(1), RegressionEvaluation(1)
+    e1.eval(y1, y1)
+    e2.eval(y2, y2)
+    e1.merge(e2)
+    assert e1.mean_squared_error(0) == pytest.approx(0.0, abs=1e-12)
+
+
+def test_roc_single_column_labels_vs_two_column_predictions():
+    # 1-col {0,1} labels with 2-col softmax predictions must read P(class 1)
+    roc = ROC(threshold_steps=50)
+    labels = np.array([[1], [1], [0], [0]], float)
+    preds = np.array([[0.1, 0.9], [0.2, 0.8], [0.9, 0.1], [0.8, 0.2]], float)
+    roc.eval(labels, preds)
+    assert roc.calculate_auc() == pytest.approx(1.0)
+
+
+def test_roc_multiclass_2d_mask():
+    labels = np.eye(3)[[0, 1, 2, 0]]
+    preds = np.eye(3)[[0, 1, 2, 1]] * 0.8 + 0.1
+    m_all = ROCMultiClass(50)
+    m_all.eval(labels, preds)
+    m_masked = ROCMultiClass(50)
+    m_masked.eval(labels, preds, mask=np.array([1, 1, 1, 0]))  # drop the error row
+    assert m_masked.calculate_average_auc() >= m_all.calculate_average_auc()
+    assert m_masked.calculate_average_auc() == pytest.approx(1.0)
+
+
+# ---------------------------------------------------------- early stopping
+
+def _toy_net(lr=0.1, algo=None):
+    b = (NeuralNetConfiguration.builder().seed(7).updater(Sgd(lr)))
+    if algo:
+        b = b.optimization_algo(algo)
+    conf = (b.list()
+            .layer(DenseLayer(n_out=8, activation="tanh"))
+            .layer(OutputLayer(n_out=2, activation="softmax", loss="MCXENT"))
+            .set_input_type(InputType.feed_forward(4))
+            .build())
+    return MultiLayerNetwork(conf).init()
+
+
+def _toy_data(n=64, seed=0):
+    rng = np.random.default_rng(seed)
+    x = rng.normal(size=(n, 4))
+    y = np.eye(2)[(x.sum(1) > 0).astype(int)]
+    return ListDataSetIterator(DataSet(x, y), batch_size=16)
+
+
+def test_early_stopping_max_epochs():
+    net = _toy_net()
+    it = _toy_data()
+    cfg = (EarlyStoppingConfiguration.builder()
+           .epoch_termination_conditions(MaxEpochsTerminationCondition(3))
+           .score_calculator(DataSetLossCalculator(_toy_data(seed=1)))
+           .model_saver(InMemoryModelSaver())
+           .build())
+    result = EarlyStoppingTrainer(cfg, net, it).fit()
+    assert result.termination_reason == TerminationReason.EPOCH_TERMINATION
+    assert result.total_epochs == 3
+    assert result.get_best_model() is not None
+    assert len(result.score_vs_epoch) == 3
+
+
+def test_early_stopping_score_improvement():
+    net = _toy_net(lr=0.0)  # no learning -> no improvement -> stops early
+    cfg = (EarlyStoppingConfiguration.builder()
+           .epoch_termination_conditions(
+               MaxEpochsTerminationCondition(50),
+               ScoreImprovementEpochTerminationCondition(2))
+           .score_calculator(DataSetLossCalculator(_toy_data(seed=1)))
+           .build())
+    result = EarlyStoppingTrainer(cfg, net, _toy_data()).fit()
+    assert result.total_epochs < 50
+
+
+def test_early_stopping_invalid_score():
+    net = _toy_net(lr=1e9)  # diverges to nan/inf quickly
+    cfg = (EarlyStoppingConfiguration.builder()
+           .epoch_termination_conditions(MaxEpochsTerminationCondition(20))
+           .iteration_termination_conditions(
+               InvalidScoreIterationTerminationCondition(),
+               MaxScoreIterationTerminationCondition(1e7))
+           .score_calculator(DataSetLossCalculator(_toy_data(seed=1)))
+           .build())
+    result = EarlyStoppingTrainer(cfg, net, _toy_data()).fit()
+    assert result.termination_reason == TerminationReason.ITERATION_TERMINATION
+
+
+def test_early_stopping_local_file_saver(tmp_path):
+    net = _toy_net()
+    cfg = (EarlyStoppingConfiguration.builder()
+           .epoch_termination_conditions(MaxEpochsTerminationCondition(2))
+           .score_calculator(DataSetLossCalculator(_toy_data(seed=1)))
+           .model_saver(LocalFileModelSaver(tmp_path))
+           .build())
+    result = EarlyStoppingTrainer(cfg, net, _toy_data()).fit()
+    best = result.get_best_model()
+    assert best is not None
+    x = np.random.default_rng(4).normal(size=(4, 4))
+    assert np.asarray(best.output(x)).shape == (4, 2)
+
+
+def test_early_stopping_requires_termination_condition():
+    net = _toy_net()
+    cfg = (EarlyStoppingConfiguration.builder()
+           .score_calculator(DataSetLossCalculator(_toy_data(seed=1)))
+           .build())
+    with pytest.raises(ValueError, match="termination"):
+        EarlyStoppingTrainer(cfg, net, _toy_data()).fit()
+
+
+# ----------------------------------------------------------------- solvers
+
+@pytest.mark.parametrize("algo", [OptimizationAlgorithm.LINE_GRADIENT_DESCENT,
+                                  OptimizationAlgorithm.CONJUGATE_GRADIENT,
+                                  OptimizationAlgorithm.LBFGS])
+def test_flat_solvers_reduce_loss(algo):
+    net = _toy_net(algo=algo)
+    rng = np.random.default_rng(5)
+    x = rng.normal(size=(32, 4))
+    y = np.eye(2)[(x.sum(1) > 0).astype(int)]
+    s0 = net.score(x, y)
+    for _ in range(5):
+        net.fit_batch(DataSet(x, y))
+    assert net.score_value < s0
+    assert np.isfinite(net.score_value)
+    # the solver instance (and its compiled fns) must be reused across batches
+    assert net._flat_solver is not None
+    assert len(net._flat_solver._fns_cache) == 1
+
+
+def test_flat_solver_computation_graph():
+    from deeplearning4j_tpu import ComputationGraph
+    conf = (NeuralNetConfiguration.builder().seed(9)
+            .optimization_algo(OptimizationAlgorithm.LBFGS)
+            .updater(Sgd(0.1))
+            .graph_builder()
+            .add_inputs("in")
+            .add_layer("d", DenseLayer(n_out=8, activation="tanh"), "in")
+            .add_layer("out", OutputLayer(n_out=2, activation="softmax",
+                                          loss="MCXENT"), "d")
+            .set_outputs("out")
+            .set_input_types(InputType.feed_forward(4))
+            .build())
+    g = ComputationGraph(conf).init()
+    rng = np.random.default_rng(10)
+    x = rng.normal(size=(32, 4))
+    y = np.eye(2)[(x.sum(1) > 0).astype(int)]
+    s0 = g.score(DataSet(x, y))
+    for _ in range(5):
+        g.fit_batch(DataSet(x, y))
+    assert g.score_value < s0
+
+
+def test_flat_solver_updates_batchnorm_stats():
+    from deeplearning4j_tpu import BatchNormalization
+    conf = (NeuralNetConfiguration.builder().seed(11)
+            .optimization_algo(OptimizationAlgorithm.LBFGS)
+            .updater(Sgd(0.1)).list()
+            .layer(DenseLayer(n_out=8, activation="identity"))
+            .layer(BatchNormalization())
+            .layer(OutputLayer(n_out=2, activation="softmax", loss="MCXENT"))
+            .set_input_type(InputType.feed_forward(4))
+            .build())
+    net = MultiLayerNetwork(conf).init()
+    rng = np.random.default_rng(12)
+    x = rng.normal(size=(32, 4)) * 3 + 1  # non-unit stats
+    y = np.eye(2)[(x.sum(1) > 0).astype(int)]
+    import jax
+    before = jax.tree_util.tree_map(np.asarray, net.states)
+    for _ in range(3):
+        net.fit_batch(DataSet(x, y))
+    after = net.states
+    changed = any(
+        not np.allclose(np.asarray(a), np.asarray(b))
+        for a, b in zip(jax.tree_util.tree_leaves(before),
+                        jax.tree_util.tree_leaves(after)))
+    assert changed, "BatchNorm running stats must update under flat solvers"
