@@ -50,6 +50,11 @@ class DataSet:
                 None if self.labels_mask is None else self.labels_mask[s:e]))
         return out
 
+    def slice(self, start, end):
+        sl = lambda a: None if a is None else a[start:end]
+        return DataSet(self.features[start:end], self.labels[start:end],
+                       sl(self.features_mask), sl(self.labels_mask))
+
     def copy(self):
         cp = lambda a: None if a is None else np.array(a)
         return DataSet(cp(self.features), cp(self.labels), cp(self.features_mask),
@@ -68,3 +73,10 @@ class MultiDataSet:
 
     def num_examples(self):
         return int(np.shape(self.features[0])[0])
+
+    def slice(self, start, end):
+        sl = lambda arrs: None if arrs is None else \
+            [None if a is None else a[start:end] for a in arrs]
+        return MultiDataSet([f[start:end] for f in self.features],
+                            [l[start:end] for l in self.labels],
+                            sl(self.features_masks), sl(self.labels_masks))

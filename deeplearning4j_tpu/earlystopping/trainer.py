@@ -88,3 +88,26 @@ class EarlyStoppingTrainer(BaseEarlyStoppingTrainer):
 
 class EarlyStoppingGraphTrainer(BaseEarlyStoppingTrainer):
     """(reference: earlystopping/trainer/EarlyStoppingGraphTrainer.java)"""
+
+
+class EarlyStoppingParallelTrainer(BaseEarlyStoppingTrainer):
+    """Early stopping over multi-device data-parallel training (reference:
+    deeplearning4j-scaleout-parallelwrapper/.../EarlyStoppingParallelTrainer.java,
+    376 LoC). Minibatches run through a ShardedTrainer (gradient all-reduce
+    over the mesh) instead of a single-device step."""
+
+    def __init__(self, config, model, train_data, workers=None, devices=None,
+                 listener=None):
+        super().__init__(config, model, train_data, listener)
+        from ..parallel.parallel_wrapper import ParallelWrapper
+        self._wrapper = ParallelWrapper(model, workers=workers, devices=devices)
+
+    def fit(self):
+        # swap the model's fit_batch for the sharded one during the loop
+        trainer = self._wrapper.trainer
+        orig = self.model.fit_batch
+        self.model.fit_batch = trainer.fit_batch
+        try:
+            return super().fit()
+        finally:
+            self.model.fit_batch = orig

@@ -39,7 +39,9 @@ def expected_input_kind(conf):
     if isinstance(conf, (L.ConvolutionLayer, L.SubsamplingLayer, L.ZeroPaddingLayer,
                          L.LocalResponseNormalization)):
         return "cnn"
-    if isinstance(conf, (L.GravesLSTM, L.LSTM, L.GravesBidirectionalLSTM, L.RnnOutputLayer)):
+    if isinstance(conf, (L.BaseRecurrentConf, L.RnnOutputLayer)):
+        # GravesLSTM/LSTM/GravesBidirectionalLSTM/SelfAttentionLayer all
+        # consume [b, t, f]
         return "recurrent"
     if isinstance(conf, (L.ActivationLayer, L.DropoutLayer, L.LossLayer,
                          L.GlobalPoolingLayer, L.BatchNormalization)):

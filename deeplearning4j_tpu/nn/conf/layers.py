@@ -273,6 +273,19 @@ class BaseRecurrentConf(FeedForwardLayerConf):
 
 @register_layer_conf
 @dataclass
+class SelfAttentionLayer(BaseRecurrentConf):
+    """Multi-head self-attention over a sequence [b,t,f] — NEW capability with
+    no reference counterpart (SURVEY.md §5: the reference has no attention).
+    Runs flash-style blockwise attention on one device; the sequence-parallel
+    long-context variant is parallel.ring_attention.ring_attention, applied to
+    the same Q/K/V projections."""
+    n_heads: int = 4
+    causal: bool = False
+    block_size: int = 256
+
+
+@register_layer_conf
+@dataclass
 class GravesLSTM(BaseRecurrentConf):
     """LSTM with peephole connections (reference: nn/conf/layers/GravesLSTM.java,
     runtime nn/layers/recurrent/LSTMHelpers.java — the per-timestep Java gemm

@@ -151,3 +151,52 @@ class GravesBidirectionalLSTMModule(BaseLayerModule):
         out_b, _ = _lstm_scan(params["bwd"], x, *zeros, c.gate_activation,
                               c.activation, True, mask, reverse=True)
         return out_f + out_b, state, mask
+
+
+@register_impl("SelfAttentionLayer")
+class SelfAttentionLayerModule(BaseLayerModule):
+    """Multi-head self-attention [b,t,f] -> [b,t,n_out] (NEW capability, no
+    reference counterpart). QKV + output projections around flash-style
+    blockwise attention; a key mask folds the sequence mask into the scores
+    and zeroes masked outputs (same convention as the LSTM scan). For
+    sequence-parallel long-context attention call
+    parallel.ring_attention.ring_attention on the projections directly."""
+
+    def init(self, rng, input_type, dtype=jnp.float32):
+        c = self.conf
+        n_in, n_out, H = int(c.n_in), int(c.n_out), int(c.n_heads)
+        assert n_out % H == 0, "n_heads must evenly divide n_out"
+        k1, k2, k3, k4 = jax.random.split(rng, 4)
+        mk = lambda k, i, o: init_weights(k, (i, o), c.weight_init, fan_in=i,
+                                          fan_out=o, distribution=c.dist,
+                                          dtype=dtype)
+        params = {
+            "Wq": mk(k1, n_in, n_out), "Wk": mk(k2, n_in, n_out),
+            "Wv": mk(k3, n_in, n_out), "Wo": mk(k4, n_out, n_out),
+            "b": jnp.full((n_out,), c.bias_init or 0.0, dtype),
+        }
+        return params, {}, InputType.recurrent(n_out)
+
+    def forward(self, params, state, x, *, train=False, rng=None, mask=None):
+        from ...parallel.ring_attention import attention_reference, \
+            blockwise_attention
+        c = self.conf
+        x = apply_dropout(x, c.dropout, train, rng)
+        B, T, _ = x.shape
+        H = int(c.n_heads)
+        Dh = int(c.n_out) // H
+        q = (x @ params["Wq"]).reshape(B, T, H, Dh)
+        k = (x @ params["Wk"]).reshape(B, T, H, Dh)
+        v = (x @ params["Wv"]).reshape(B, T, H, Dh)
+        if mask is not None:
+            out = attention_reference(q, k, v, causal=c.causal, key_mask=mask)
+        elif T % min(int(c.block_size), T) == 0:
+            out = blockwise_attention(q, k, v, block_size=int(c.block_size),
+                                      causal=c.causal)
+        else:
+            out = attention_reference(q, k, v, causal=c.causal)
+        out = out.reshape(B, T, int(c.n_out)) @ params["Wo"] + params["b"]
+        out = self.activation_fn()(out)
+        if mask is not None:
+            out = out * mask[:, :, None]  # zero masked steps like the LSTM scan
+        return out, state, mask

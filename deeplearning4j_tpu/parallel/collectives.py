@@ -1,0 +1,109 @@
+"""Distributed communication backend: XLA collectives over ICI/DCN.
+
+This module replaces the reference's entire comm-backend inventory
+(SURVEY.md §2.5):
+- `Nd4j.averageAndPropagate` device averaging (ParallelWrapper.java:381)
+  -> `all_reduce_mean` inside compiled programs (ICI)
+- Aeron UDP parameter server (ParameterServerParallelWrapper.java:3,170)
+  -> nothing: gradients ride ICI/DCN collectives, no user-space transport
+- Spark driver<->executor RPC/broadcast/aggregate
+  (ParameterAveragingTrainingMaster.java:344-378) -> multi-host SPMD: every
+  process runs the same jit program; `initialize_distributed` bootstraps the
+  PJRT-level mesh over DCN.
+
+All collective wrappers must be called inside a `shard_map`/`pmap` context
+with the named mesh axis bound.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh, PartitionSpec as P
+
+
+def initialize_distributed(coordinator_address=None, num_processes=None,
+                           process_id=None, local_device_ids=None):
+    """Multi-host bootstrap (the analog of the reference's Spark/Aeron cluster
+    setup; here one call wires PJRT processes into one global device view over
+    DCN). No-op when single-process."""
+    if num_processes is None or num_processes <= 1:
+        return False
+    jax.distributed.initialize(
+        coordinator_address=coordinator_address,
+        num_processes=num_processes,
+        process_id=process_id,
+        local_device_ids=local_device_ids)
+    return True
+
+
+def multi_slice_mesh(axis_shapes, axis_names, devices=None):
+    """Hybrid ICI/DCN mesh for multi-slice topologies: the FIRST axis is laid
+    out across slices (DCN), remaining axes within a slice (ICI). Falls back
+    to a plain reshape when the platform exposes no slice structure (CPU
+    meshes in tests)."""
+    devices = devices if devices is not None else jax.devices()
+    try:
+        from jax.experimental import mesh_utils
+        # contract: mesh_shape (ICI) and dcn_mesh_shape have the same length;
+        # slice-crossing parallelism only on the first axis
+        arr = mesh_utils.create_hybrid_device_mesh(
+            mesh_shape=(1,) + tuple(axis_shapes[1:]),
+            dcn_mesh_shape=(axis_shapes[0],) + (1,) * (len(axis_shapes) - 1),
+            devices=devices)
+        arr = arr.reshape(axis_shapes)
+    except ValueError:
+        # no slice structure exposed (single-slice TPU, CPU test meshes):
+        # plain reshape is correct there; real topology errors still raise
+        arr = np.array(devices).reshape(axis_shapes)
+    return Mesh(arr, axis_names)
+
+
+# ---------------------------------------------------------- collective ops
+# Thin, named wrappers so framework code reads like the comm backend it
+# replaces. Inside jit/shard_map these lower to single XLA collectives that
+# ride ICI (intra-slice) or DCN (across slices), chosen by the mesh layout.
+
+def all_reduce_sum(x, axis):
+    return jax.lax.psum(x, axis_name=axis)
+
+
+def all_reduce_mean(x, axis):
+    """The analog of Nd4j.averageAndPropagate (ParallelWrapper.java:381)."""
+    return jax.lax.pmean(x, axis_name=axis)
+
+
+def all_reduce_max(x, axis):
+    return jax.lax.pmax(x, axis_name=axis)
+
+
+def all_gather(x, axis, *, gather_axis=0, tiled=False):
+    return jax.lax.all_gather(x, axis_name=axis, axis=gather_axis, tiled=tiled)
+
+
+def reduce_scatter(x, axis, *, scatter_axis=0):
+    return jax.lax.psum_scatter(x, axis_name=axis, scatter_dimension=scatter_axis,
+                                tiled=True)
+
+
+def ring_shift(x, axis, shift=1):
+    """Rotate x one hop around the ring of devices on `axis` (ppermute) —
+    the building block of ring attention."""
+    n = jax.lax.psum(1, axis_name=axis)
+    perm = [(i, (i + shift) % n) for i in range(n)]
+    return jax.lax.ppermute(x, axis_name=axis, perm=perm)
+
+
+def axis_index(axis):
+    return jax.lax.axis_index(axis)
+
+
+def axis_size(axis):
+    return jax.lax.psum(1, axis_name=axis)
+
+
+def broadcast_from(x, axis, src=0):
+    """Broadcast the value held by device `src` on `axis` to all devices."""
+    idx = jax.lax.axis_index(axis)
+    masked = jnp.where(idx == src, x, jnp.zeros_like(x))
+    return jax.lax.psum(masked, axis_name=axis)

@@ -1,0 +1,153 @@
+"""Long-context attention: blockwise (flash-style) and ring attention.
+
+NEW first-class capability with no reference counterpart (SURVEY.md §5
+"Long-context / sequence parallelism: none" — the reference's long-sequence
+story is truncated BPTT + masking only). Design follows the public ring
+attention recipe (blockwise online-softmax accumulation + ppermute of K/V
+around the ICI ring) so sequence length scales linearly with the number of
+devices on the `seq` mesh axis.
+
+Shapes: q/k/v are [batch, time, heads, head_dim] (BTHD).
+"""
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import NamedSharding, PartitionSpec as P
+try:
+    from jax import shard_map            # jax >= 0.8
+except ImportError:                      # pragma: no cover
+    from jax.experimental.shard_map import shard_map
+
+from .sharding import SEQ_AXIS
+
+NEG_INF = -1e30
+
+
+def attention_reference(q, k, v, *, causal=False, scale=None, key_mask=None):
+    """Plain softmax attention (the correctness oracle for the blockwise and
+    ring paths). key_mask: optional [batch, time] validity of key positions."""
+    B, Tq, H, D = q.shape
+    Tk = k.shape[1]
+    scale = scale if scale is not None else 1.0 / jnp.sqrt(D).astype(q.dtype)
+    s = jnp.einsum("bqhd,bkhd->bhqk", q, k) * scale
+    if key_mask is not None:
+        s = jnp.where(key_mask[:, None, None, :] > 0, s, NEG_INF)
+    if causal:
+        qpos = jnp.arange(Tq)[:, None]
+        kpos = jnp.arange(Tk)[None, :]
+        s = jnp.where((kpos > qpos)[None, None], NEG_INF, s)
+    p = jax.nn.softmax(s, axis=-1)
+    return jnp.einsum("bhqk,bkhd->bqhd", p, v)
+
+
+def _block_update(carry, kv, q, scale, mask_fn=None):
+    """Online-softmax accumulation of one K/V block into (o, m, l)."""
+    o, m, l = carry
+    kb, vb, k_off = kv
+    s = jnp.einsum("bqhd,bkhd->bhqk", q, kb) * scale      # B,H,Tq,Tb
+    if mask_fn is not None:
+        s = mask_fn(s, k_off)
+    m_blk = jnp.max(s, axis=-1)                           # B,H,Tq
+    m_new = jnp.maximum(m, m_blk)
+    corr = jnp.exp(m - m_new)
+    p = jnp.exp(s - m_new[..., None])                     # B,H,Tq,Tb
+    l = l * corr + jnp.sum(p, axis=-1)
+    o = o * corr[..., None] + jnp.einsum("bhqk,bkhd->bhqd", p, vb)
+    return (o, m_new, l), None
+
+
+def blockwise_attention(q, k, v, *, block_size=256, causal=False, scale=None):
+    """Single-device flash-style attention: scan over K/V blocks with online
+    softmax — O(T_block) memory instead of O(T^2). Numerically identical to
+    attention_reference."""
+    B, Tq, H, D = q.shape
+    Tk = k.shape[1]
+    block_size = min(block_size, Tk)
+    assert Tk % block_size == 0, "block_size must evenly divide the key length"
+    n_blocks = Tk // block_size
+    scale = scale if scale is not None else 1.0 / jnp.sqrt(D).astype(q.dtype)
+
+    kb = k.reshape(B, n_blocks, block_size, H, D).transpose(1, 0, 2, 3, 4)
+    vb = v.reshape(B, n_blocks, block_size, H, D).transpose(1, 0, 2, 3, 4)
+    offs = jnp.arange(n_blocks) * block_size
+
+    mask_fn = None
+    if causal:
+        qpos = jnp.arange(Tq)
+
+        def mask_fn(s, k_off):
+            kpos = k_off + jnp.arange(block_size)
+            bad = kpos[None, :] > qpos[:, None]           # Tq, Tb
+            return jnp.where(bad[None, None], NEG_INF, s)
+
+    o0 = jnp.zeros((B, H, Tq, D), q.dtype)
+    m0 = jnp.full((B, H, Tq), NEG_INF, q.dtype)
+    l0 = jnp.zeros((B, H, Tq), q.dtype)
+    (o, m, l), _ = jax.lax.scan(
+        functools.partial(_block_update, q=q, scale=scale, mask_fn=mask_fn),
+        (o0, m0, l0), (kb, vb, offs))
+    out = o / jnp.maximum(l[..., None], 1e-30)
+    return out.transpose(0, 2, 1, 3)                      # back to BTHD
+
+
+def _ring_attention_local(q, k, v, *, causal, scale, axis_name):
+    """Per-shard body under shard_map: each device owns a time-slice of
+    q/k/v; K/V blocks rotate around the ring (ppermute over ICI), queries
+    accumulate online-softmax partials."""
+    B, Tq, H, D = q.shape
+    n = jax.lax.psum(1, axis_name)
+    my = jax.lax.axis_index(axis_name)
+    scale = scale if scale is not None else 1.0 / jnp.sqrt(D).astype(q.dtype)
+
+    # derive accumulators from q so shard_map's varying-axis tracking sees
+    # them as seq-varying (a plain jnp.zeros would be unvarying and fail the
+    # fori_loop carry type check)
+    qt = q.transpose(0, 2, 1, 3)                       # B,H,Tq,D
+    o = qt * 0.0
+    m = qt[..., 0] * 0.0 + NEG_INF                     # B,H,Tq
+    l = qt[..., 0] * 0.0
+    perm = [(i, (i + 1) % n) for i in range(n)]
+
+    def body(r, state):
+        o, m, l, kr, vr = state
+        # kr/vr originated on device (my - r) mod n
+        src = (my - r) % n
+        s = jnp.einsum("bqhd,bkhd->bhqk", q, kr) * scale
+        if causal:
+            qpos = my * Tq + jnp.arange(Tq)
+            kpos = src * Tq + jnp.arange(Tq)
+            bad = kpos[None, :] > qpos[:, None]
+            s = jnp.where(bad[None, None], NEG_INF, s)
+        m_blk = jnp.max(s, axis=-1)
+        m_new = jnp.maximum(m, m_blk)
+        corr = jnp.exp(m - m_new)
+        p = jnp.exp(s - m_new[..., None])
+        l = l * corr + jnp.sum(p, axis=-1)
+        o = o * corr[..., None] + jnp.einsum("bhqk,bkhd->bhqd", p, vr)
+        kr = jax.lax.ppermute(kr, axis_name, perm)
+        vr = jax.lax.ppermute(vr, axis_name, perm)
+        return o, m_new, l, kr, vr
+
+    o, m, l, _, _ = jax.lax.fori_loop(0, n, body, (o, m, l, k, v))
+    out = o / jnp.maximum(l[..., None], 1e-30)
+    return out.transpose(0, 2, 1, 3)
+
+
+def ring_attention(q, k, v, mesh, *, causal=False, scale=None,
+                   axis_name=SEQ_AXIS):
+    """Sequence-parallel attention over `mesh`'s `axis_name` ring: time is
+    sharded across devices; peak memory per device is O(T/n) and the K/V
+    transfer rides the ICI ring concurrently with compute."""
+    spec = P(None, axis_name, None, None)
+    sh = NamedSharding(mesh, spec)
+    fn = shard_map(
+        functools.partial(_ring_attention_local, causal=causal, scale=scale,
+                          axis_name=axis_name),
+        mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec)
+    q = jax.device_put(q, sh)
+    k = jax.device_put(k, sh)
+    v = jax.device_put(v, sh)
+    return fn(q, k, v)

@@ -161,10 +161,27 @@ class ShardedTrainer:
         a = jnp.asarray(arr) if dtype is None else jnp.asarray(arr, dtype)
         return jax.device_put(a, batch_sharding(self.mesh, a.ndim))
 
+    def _trim(self, ds):
+        """Truncate a batch to a multiple of the data-axis size — NamedSharding
+        placement needs even divisibility; the tail of a final partial batch
+        is dropped like the reference's uneven-split handling. Returns None if
+        the batch is smaller than the data axis."""
+        n = self.mesh.shape[DATA_AXIS]
+        b = ds.num_examples()
+        keep = (b // n) * n
+        if keep == b:
+            return ds
+        if keep == 0:
+            return None
+        return ds.slice(0, keep)
+
     def fit_batch(self, ds):
         """One globally-batched step: the batch is split over the data axis;
         XLA all-reduces gradients over ICI."""
         m = self.model
+        ds = self._trim(ds)
+        if ds is None:
+            return m.score_value
         if self._step is None:
             self._step = self._build_step()
         from ..nn.multilayer.network import MultiLayerNetwork
