@@ -1,0 +1,12 @@
+"""Clustering + spatial trees (reference: deeplearning4j-core clustering/ —
+kmeans/KMeansClustering.java, kdtree/, vptree/, quadtree/, sptree/SpTree.java,
+cluster/ model classes; 33 files, ~4.1k LoC). Supports t-SNE and
+nearest-neighbor workloads.
+"""
+from .kmeans import KMeansClustering, Cluster, ClusterSet, Point
+from .kdtree import KDTree
+from .vptree import VPTree
+from .sptree import SpTree
+
+__all__ = ["KMeansClustering", "Cluster", "ClusterSet", "Point",
+           "KDTree", "VPTree", "SpTree"]

@@ -1,0 +1,99 @@
+"""KD-tree for nearest-neighbor queries.
+
+Reference: clustering/kdtree/KDTree.java (+ HyperRect.java) — axis-cycled
+binary space partition with insert, nn (nearest neighbour) and knn queries.
+Host-side structure (tree build/search is pointer-chasing, not MXU work).
+"""
+from __future__ import annotations
+
+import heapq
+
+import numpy as np
+
+
+class _Node:
+    __slots__ = ("point", "idx", "left", "right", "axis")
+
+    def __init__(self, point, idx, axis):
+        self.point = point
+        self.idx = idx
+        self.axis = axis
+        self.left = None
+        self.right = None
+
+
+class KDTree:
+    def __init__(self, dims=None, points=None):
+        self.dims = dims
+        self.root = None
+        self.size = 0
+        if points is not None:
+            points = np.asarray(points, np.float64)
+            self.dims = points.shape[1]
+            # balanced bulk build by median split
+            idxs = np.arange(len(points))
+            self.root = self._build(points, idxs, 0)
+            self.size = len(points)
+
+    def _build(self, pts, idxs, depth):
+        if len(idxs) == 0:
+            return None
+        axis = depth % self.dims
+        order = idxs[np.argsort(pts[idxs, axis])]
+        mid = len(order) // 2
+        node = _Node(pts[order[mid]], int(order[mid]), axis)
+        node.left = self._build(pts, order[:mid], depth + 1)
+        node.right = self._build(pts, order[mid + 1:], depth + 1)
+        return node
+
+    def insert(self, point, idx=None):
+        point = np.asarray(point, np.float64)
+        if self.dims is None:
+            self.dims = len(point)
+        idx = self.size if idx is None else idx
+        node = _Node(point, idx, 0)
+        if self.root is None:
+            self.root = node
+        else:
+            cur = self.root
+            depth = 0
+            while True:
+                axis = depth % self.dims
+                branch = "left" if point[axis] < cur.point[axis] else "right"
+                nxt = getattr(cur, branch)
+                if nxt is None:
+                    node.axis = (depth + 1) % self.dims
+                    setattr(cur, branch, node)
+                    break
+                cur = nxt
+                depth += 1
+        self.size += 1
+        return idx
+
+    def nn(self, query):
+        """Nearest neighbour: returns (distance, point, idx)."""
+        res = self.knn(query, 1)
+        return res[0] if res else None
+
+    def knn(self, query, k):
+        """k nearest: [(distance, point, idx)] ascending."""
+        query = np.asarray(query, np.float64)
+        heap = []  # max-heap by -dist
+
+        def visit(node, depth):
+            if node is None:
+                return
+            d = float(np.linalg.norm(node.point - query))
+            if len(heap) < k:
+                heapq.heappush(heap, (-d, node.idx, node.point))
+            elif d < -heap[0][0]:
+                heapq.heapreplace(heap, (-d, node.idx, node.point))
+            axis = depth % self.dims
+            diff = query[axis] - node.point[axis]
+            near, far = (node.left, node.right) if diff < 0 else (node.right, node.left)
+            visit(near, depth + 1)
+            if len(heap) < k or abs(diff) < -heap[0][0]:
+                visit(far, depth + 1)
+
+        visit(self.root, 0)
+        return sorted([(-h[0], h[2], h[1]) for h in heap])

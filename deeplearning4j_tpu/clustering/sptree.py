@@ -1,0 +1,101 @@
+"""Space-partitioning tree (generalized quadtree/octree) for Barnes-Hut.
+
+Reference: clustering/sptree/SpTree.java (+ quadtree/ 2-D special case) —
+cell subdivision with center-of-mass aggregation, used by BarnesHutTsne for
+O(N log N) repulsive-force estimation.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+
+class SpTree:
+    def __init__(self, data, corner=None, width=None):
+        data = np.asarray(data, np.float64)
+        self.dim = data.shape[1]
+        if corner is None:
+            mins = data.min(0)
+            maxs = data.max(0)
+            center = (mins + maxs) / 2
+            width = (maxs - mins).max() * 0.5 + 1e-5
+            corner = center - width
+            width = np.full(self.dim, 2 * width)
+        self.corner = np.asarray(corner, np.float64)
+        self.width = np.asarray(width, np.float64)
+        self.center_of_mass = np.zeros(self.dim)
+        self.cum_size = 0
+        self.children = None
+        self.point = None
+        self.point_idx = -1
+        for i, p in enumerate(data):
+            self.insert(p, i)
+
+    @classmethod
+    def _empty(cls, corner, width):
+        node = cls.__new__(cls)
+        node.dim = len(corner)
+        node.corner = corner
+        node.width = width
+        node.center_of_mass = np.zeros(node.dim)
+        node.cum_size = 0
+        node.children = None
+        node.point = None
+        node.point_idx = -1
+        return node
+
+    def _contains(self, p):
+        return np.all(p >= self.corner) and np.all(p <= self.corner + self.width)
+
+    def insert(self, p, idx):
+        if not self._contains(p):
+            return False
+        self.cum_size += 1
+        self.center_of_mass += (p - self.center_of_mass) / self.cum_size
+        if self.children is None and self.point is None:
+            self.point = np.array(p)
+            self.point_idx = idx
+            return True
+        if self.children is None:
+            if np.allclose(self.point, p):
+                return True  # duplicate point: mass already counted
+            self._subdivide()
+        for c in self.children:
+            if c.insert(p, idx):
+                return True
+        return False
+
+    def _subdivide(self):
+        half = self.width / 2
+        self.children = []
+        for mask in range(2 ** self.dim):
+            offs = np.array([(mask >> d) & 1 for d in range(self.dim)])
+            corner = self.corner + offs * half
+            self.children.append(SpTree._empty(corner, half))
+        p, i = self.point, self.point_idx
+        self.point = None
+        self.point_idx = -1
+        for c in self.children:
+            if c.insert(p, i):
+                break
+
+    def compute_non_edge_forces(self, point, theta, neg_f):
+        """Barnes-Hut negative-force accumulation for one query point
+        (reference: SpTree.computeNonEdgeForces). Returns the accumulated
+        normalization sum; neg_f is mutated in place."""
+        if self.cum_size == 0:
+            return 0.0
+        diff = point - self.center_of_mass
+        d2 = float(diff @ diff)
+        max_width = float(self.width.max())
+        if self.children is None or (d2 > 0 and max_width ** 2 / d2 < theta ** 2):
+            if self.point is not None and np.allclose(self.point, point):
+                return 0.0
+            q = 1.0 / (1.0 + d2)
+            mult = self.cum_size * q
+            s = mult
+            neg_f += mult * q * diff
+            return s
+        s = 0.0
+        for c in self.children:
+            s += c.compute_non_edge_forces(point, theta, neg_f)
+        return s

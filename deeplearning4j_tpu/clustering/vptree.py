@@ -1,0 +1,87 @@
+"""Vantage-point tree for metric nearest-neighbor search.
+
+Reference: clustering/vptree/VPTree.java — the structure Barnes-Hut t-SNE uses
+to find the k nearest neighbours under arbitrary metrics
+(plot/BarnesHutTsne.java uses it for the input-similarity sparse P matrix).
+"""
+from __future__ import annotations
+
+import heapq
+
+import numpy as np
+
+
+class _VPNode:
+    __slots__ = ("idx", "threshold", "inside", "outside")
+
+    def __init__(self, idx):
+        self.idx = idx
+        self.threshold = 0.0
+        self.inside = None
+        self.outside = None
+
+
+class VPTree:
+    def __init__(self, points, distance="euclidean", seed=0):
+        self.points = np.asarray(points, np.float64)
+        self.distance = distance
+        self._rng = np.random.default_rng(seed)
+        idxs = list(range(len(self.points)))
+        self.root = self._build(idxs)
+
+    def _dist(self, a, b):
+        if self.distance == "cosine":
+            na, nb = np.linalg.norm(a), np.linalg.norm(b)
+            if na == 0 or nb == 0:
+                return 1.0
+            return 1.0 - float(a @ b / (na * nb))
+        return float(np.linalg.norm(a - b))
+
+    def _build(self, idxs):
+        if not idxs:
+            return None
+        vp = idxs[self._rng.integers(0, len(idxs))]
+        rest = [i for i in idxs if i != vp]
+        node = _VPNode(vp)
+        if not rest:
+            return node
+        dists = [self._dist(self.points[vp], self.points[i]) for i in rest]
+        median = float(np.median(dists))
+        node.threshold = median
+        inside = [i for i, d in zip(rest, dists) if d < median]
+        outside = [i for i, d in zip(rest, dists) if d >= median]
+        node.inside = self._build(inside)
+        node.outside = self._build(outside)
+        return node
+
+    def search(self, query, k):
+        """k nearest to query: ([indices], [distances]) ascending."""
+        query = np.asarray(query, np.float64)
+        heap = []  # (-dist, idx) max-heap
+        tau = [np.inf]
+
+        def visit(node):
+            if node is None:
+                return
+            d = self._dist(self.points[node.idx], query)
+            if len(heap) < k:
+                heapq.heappush(heap, (-d, node.idx))
+                if len(heap) == k:
+                    tau[0] = -heap[0][0]
+            elif d < tau[0]:
+                heapq.heapreplace(heap, (-d, node.idx))
+                tau[0] = -heap[0][0]
+            if node.inside is None and node.outside is None:
+                return
+            if d < node.threshold:
+                visit(node.inside)
+                if d + tau[0] >= node.threshold:
+                    visit(node.outside)
+            else:
+                visit(node.outside)
+                if d - tau[0] <= node.threshold:
+                    visit(node.inside)
+
+        visit(self.root)
+        pairs = sorted((-hd, i) for hd, i in heap)
+        return [i for _, i in pairs], [d for d, _ in pairs]

@@ -1,0 +1,174 @@
+"""Standard dataset fetchers/iterators beyond MNIST.
+
+Reference: deeplearning4j-core datasets/iterator/impl/{IrisDataSetIterator,
+CifarDataSetIterator, LFWDataSetIterator, CurvesDataSetIterator}.java and
+datasets/fetchers/{IrisDataFetcher, CifarDataFetcher, LFWDataFetcher}.java.
+
+Zero-egress environment: like the MNIST fetcher, each iterator looks for a
+local copy first (env var pointing at the standard binary layout) and falls
+back to a deterministic, clearly-synthetic surrogate with the same shapes and
+class-conditional structure so models can actually learn in tests/benchmarks.
+"""
+from __future__ import annotations
+
+import os
+
+import numpy as np
+
+from ..dataset import DataSet
+from ..iterator.base import DataSetIterator
+
+
+class _ArrayIterator(DataSetIterator):
+    """Batch iterator over in-memory arrays."""
+
+    def __init__(self, x, y, batch_size):
+        self._x, self._y = x, y
+        self.batch = int(batch_size)
+        self._i = 0
+
+    def reset(self):
+        self._i = 0
+        return self
+
+    def has_next(self):
+        return self._i < len(self._x)
+
+    def next(self, num=None):
+        n = num or self.batch
+        s = self._i
+        self._i += n
+        return DataSet(self._x[s:s + n], self._y[s:s + n])
+
+    def total_examples(self):
+        return len(self._x)
+
+    def input_columns(self):
+        return int(np.prod(self._x.shape[1:]))
+
+    def total_outcomes(self):
+        return self._y.shape[-1]
+
+    def __iter__(self):
+        while self.has_next():
+            yield self.next()
+
+
+def _synthetic_gaussian_classes(n, dims, n_classes, seed, spread=2.0):
+    """Deterministic class-conditional Gaussian clusters."""
+    rng = np.random.default_rng(seed)
+    means = rng.normal(scale=spread, size=(n_classes,) + (dims if isinstance(dims, tuple) else (dims,)))
+    ys = np.tile(np.arange(n_classes), n // n_classes + 1)[:n]
+    x = means[ys] + rng.normal(scale=1.0, size=(n,) + means.shape[1:])
+    y = np.eye(n_classes, dtype=np.float32)[ys]
+    order = rng.permutation(n)
+    return x[order].astype(np.float32), y[order]
+
+
+class IrisDataSetIterator(_ArrayIterator):
+    """(reference: datasets/iterator/impl/IrisDataSetIterator.java; fetcher
+    datasets/fetchers/IrisDataFetcher.java — 150 x 4 features, 3 classes).
+    Loads a local `iris.data` CSV (IRIS_PATH env) or synthesizes 3-cluster
+    data with the same shape."""
+
+    N, DIMS, CLASSES = 150, 4, 3
+
+    def __init__(self, batch_size=150, num_examples=150):
+        path = os.environ.get("IRIS_PATH")
+        if path and os.path.exists(path):
+            rows = []
+            names = {}
+            with open(path) as fh:
+                for line in fh:
+                    parts = line.strip().split(",")
+                    if len(parts) != 5:
+                        continue
+                    lbl = names.setdefault(parts[4], len(names))
+                    rows.append([float(v) for v in parts[:4]] + [lbl])
+            arr = np.array(rows, np.float32)
+            x = arr[:, :4]
+            y = np.eye(self.CLASSES, dtype=np.float32)[arr[:, 4].astype(int)]
+        else:
+            x, y = _synthetic_gaussian_classes(self.N, self.DIMS, self.CLASSES,
+                                               seed=4242)
+        super().__init__(x[:num_examples], y[:num_examples], batch_size)
+
+
+class CifarDataSetIterator(_ArrayIterator):
+    """(reference: datasets/iterator/impl/CifarDataSetIterator.java — 32x32x3,
+    10 classes). Local CIFAR-10 binary batches via CIFAR_DIR, else synthetic
+    class-conditional images (NHWC float32 in [0,1])."""
+
+    H = W = 32
+    C = 3
+    CLASSES = 10
+
+    def __init__(self, batch_size=32, num_examples=1000, train=True):
+        cdir = os.environ.get("CIFAR_DIR")
+        x = y = None
+        if cdir and os.path.isdir(cdir):
+            files = [f"data_batch_{i}.bin" for i in range(1, 6)] if train \
+                else ["test_batch.bin"]
+            xs, ys = [], []
+            for f in files:
+                p = os.path.join(cdir, f)
+                if not os.path.exists(p):
+                    continue
+                raw = np.fromfile(p, np.uint8).reshape(-1, 3073)
+                ys.append(raw[:, 0])
+                xs.append(raw[:, 1:].reshape(-1, 3, 32, 32).transpose(0, 2, 3, 1))
+            if xs:
+                x = (np.concatenate(xs) / 255.0).astype(np.float32)
+                y = np.eye(self.CLASSES, dtype=np.float32)[np.concatenate(ys)]
+        if x is None:
+            rng = np.random.default_rng(777 if train else 778)
+            ys_i = np.tile(np.arange(self.CLASSES),
+                           num_examples // self.CLASSES + 1)[:num_examples]
+            # class-conditional blob pattern + noise
+            base = rng.normal(size=(self.CLASSES, self.H, self.W, self.C))
+            x = (base[ys_i] * 0.4 +
+                 rng.normal(scale=0.3, size=(num_examples, self.H, self.W, self.C)))
+            x = ((x - x.min()) / (x.max() - x.min())).astype(np.float32)
+            y = np.eye(self.CLASSES, dtype=np.float32)[ys_i]
+        super().__init__(x[:num_examples], y[:num_examples], batch_size)
+
+
+class LFWDataSetIterator(_ArrayIterator):
+    """(reference: datasets/iterator/impl/LFWDataSetIterator.java — labelled
+    faces; default 250x250x3 scaled down). Synthetic fallback with
+    `num_labels` identities at image_size."""
+
+    def __init__(self, batch_size=16, num_examples=64, image_size=(64, 64),
+                 num_labels=8):
+        h, w = image_size
+        rng = np.random.default_rng(999)
+        ys_i = np.tile(np.arange(num_labels),
+                       num_examples // num_labels + 1)[:num_examples]
+        base = rng.normal(size=(num_labels, h, w, 3))
+        x = base[ys_i] * 0.5 + rng.normal(scale=0.25,
+                                          size=(num_examples, h, w, 3))
+        x = ((x - x.min()) / (x.max() - x.min())).astype(np.float32)
+        y = np.eye(num_labels, dtype=np.float32)[ys_i]
+        super().__init__(x, y, batch_size)
+
+
+class CurvesDataSetIterator(_ArrayIterator):
+    """(reference: datasets/iterator/impl/CurvesDataSetIterator.java — the
+    'curves' autoencoder benchmark: 28x28 synthetic curve images). Generated
+    deterministic sine-curve raster images; labels == features (autoencoder
+    regime, like the reference's unsupervised use)."""
+
+    def __init__(self, batch_size=32, num_examples=256, size=28):
+        rng = np.random.default_rng(1234)
+        xs = np.zeros((num_examples, size * size), np.float32)
+        t = np.linspace(0, 1, size)
+        for i in range(num_examples):
+            amp = rng.uniform(0.2, 0.45)
+            freq = rng.uniform(0.5, 3.0)
+            phase = rng.uniform(0, 2 * np.pi)
+            curve = 0.5 + amp * np.sin(2 * np.pi * freq * t + phase)
+            img = np.zeros((size, size), np.float32)
+            rows = np.clip((curve * size).astype(int), 0, size - 1)
+            img[rows, np.arange(size)] = 1.0
+            xs[i] = img.ravel()
+        super().__init__(xs, xs.copy(), batch_size)

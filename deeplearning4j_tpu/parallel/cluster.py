@@ -34,13 +34,9 @@ class TrainingMaster:
         raise NotImplementedError
 
 
-class TrainingWorker:
-    """SPI (reference: api/TrainingWorker.java) — processes minibatches on one
-    replica and exposes the final result."""
-
-    def __init__(self, model_step, replica_idx):
-        self.model_step = model_step
-        self.replica_idx = replica_idx
+# NOTE: the reference's TrainingWorker SPI (api/TrainingWorker.java — one
+# executor processing minibatches on its replica) has no class here: the
+# replica axis of the vmapped step in _execute_averaging plays that role.
 
 
 class ParameterAveragingTrainingMaster(TrainingMaster):
@@ -89,23 +85,49 @@ class ParameterAveragingTrainingMaster(TrainingMaster):
         return ParameterAveragingTrainingMaster.Builder(batch_size_per_worker)
 
     # ------------------------------------------------------------ training
+    def _rebatched(self, data_iterator, size):
+        """Re-cut the incoming batch stream into `size`-example minibatches
+        (the reference's batchSizePerWorker contract: workers always see that
+        minibatch size regardless of upstream batching)."""
+        from ..datasets.iterator.base import as_iterator
+        it = as_iterator(data_iterator)
+        it.reset()
+        carry = None
+        for ds in it:
+            cur = ds if carry is None else _concat_datasets(carry, ds)
+            carry = None
+            n = cur.num_examples()
+            s = 0
+            while n - s >= size:
+                yield cur.slice(s, s + size)
+                s += size
+            if s < n:
+                carry = cur.slice(s, n)
+        if carry is not None:
+            yield carry
+
     def execute_training(self, model, data_iterator):
         if self.mode == "allreduce":
-            from .parallel_wrapper import ParallelWrapper
-            pw = ParallelWrapper(model, workers=self.worker_count,
-                                 devices=self.devices)
-            pw.fit(data_iterator)
+            if getattr(self, "_pw", None) is None or self._pw.model is not model:
+                from .parallel_wrapper import ParallelWrapper
+                self._pw = ParallelWrapper(model, workers=self.worker_count,
+                                           devices=self.devices)
+            n = self._pw.workers
+            batches = list(self._rebatched(data_iterator,
+                                           self.batch_size_per_worker * n))
+            self._pw.fit(batches)
             return model
         return self._execute_averaging(model, data_iterator)
 
     def _execute_averaging(self, model, data_iterator):
         """Faithful averaging-window semantics via vmapped replicas."""
-        from ..datasets.iterator.base import as_iterator
         n = self.worker_count or len(self.devices or jax.devices())
         if model.params is None:
             model.init()
-        step = model._get_train_step("std") if hasattr(model, "_get_train_step") \
-            else model._make_train_step()
+
+        from ..nn.multilayer.network import MultiLayerNetwork
+        is_mln = isinstance(model, MultiLayerNetwork)
+        step = model._get_train_step("std") if is_mln else model._make_train_step()
 
         # replicate: stack params/opt_state/states on a leading replica axis
         stack = lambda t: jax.tree_util.tree_map(
@@ -115,43 +137,78 @@ class ParameterAveragingTrainingMaster(TrainingMaster):
         opt_state = stack(model.opt_state)
         states = stack(model.states)
 
-        vstep = jax.vmap(
-            lambda p, o, s, r, x, y: step(p, o, s, r, x, y, None, None, None))
+        def run_step(params, opt_state, states, rngs, x, y, mask, lmask):
+            """vmap the per-replica step, adapting the MLN (9-arg, 5-result)
+            vs ComputationGraph (8-arg, 4-result) train-step signatures and
+            passing masks through (in_axes None when absent)."""
+            in_axes = (0, 0, 0, 0, 0, 0,
+                       None if mask is None else 0,
+                       None if lmask is None else 0)
+            if is_mln:
+                fn = lambda p, o, s, r, xx, yy, m, lm: \
+                    step(p, o, s, r, xx, yy, m, lm, None)[:4]
+            else:
+                fn = lambda p, o, s, r, xx, yy, m, lm: \
+                    step(p, o, s, r, [xx], [yy],
+                         None if m is None else [m],
+                         None if lm is None else [lm])
+            return jax.vmap(fn, in_axes=in_axes)(
+                params, opt_state, states, rngs, x, y, mask, lmask)
 
+        from ..datasets.iterator.base import as_iterator
         it = as_iterator(data_iterator)
         it.reset()
-        buf_x, buf_y = [], []
+        bufs = {"x": [], "y": [], "m": [], "lm": []}
         iters_since_avg = 0
         score = float("nan")
+
+        def push(ds):
+            feats = ds.features[0] if isinstance(ds.features, list) else ds.features
+            labels = ds.labels[0] if isinstance(ds.labels, list) else ds.labels
+            fm = getattr(ds, "features_mask", None)
+            lm = getattr(ds, "labels_mask", None)
+            bufs["x"].append(np.asarray(feats))
+            bufs["y"].append(np.asarray(labels))
+            bufs["m"].append(None if fm is None else np.asarray(fm))
+            bufs["lm"].append(None if lm is None else np.asarray(lm))
+
+        def stack_buf(key, dtype=None):
+            vals = bufs[key]
+            if any(v is None for v in vals):
+                return None
+            min_b = min(v.shape[0] for v in vals)  # ragged final batch guard
+            arr = np.stack([v[:min_b] for v in vals])
+            return jnp.asarray(arr) if dtype is None else jnp.asarray(arr, dtype)
+
         # partial final window: cycle the already-buffered batches so every
         # replica still trains on real data (the reference re-partitions the
         # split so no executor idles, ParameterAveragingTrainingMaster
-        # .doIteration). One-batch lookahead keeps memory at O(window), not
-        # O(dataset).
+        # .doIteration). One-batch lookahead keeps memory at O(window).
         stream = iter(it)
         pending = next(stream, None)
         while pending is not None:
             ds = pending
             pending = next(stream, None)
-            buf_x.append(np.asarray(ds.features))
-            buf_y.append(np.asarray(ds.labels))
-            if len(buf_x) < n:
+            push(ds)
+            if len(bufs["x"]) < n:
                 if pending is None:
                     j = 0
-                    while len(buf_x) < n:
-                        buf_x.append(buf_x[j])
-                        buf_y.append(buf_y[j])
+                    while len(bufs["x"]) < n:
+                        for k in bufs:
+                            bufs[k].append(bufs[k][j])
                         j += 1
                 else:
                     continue
-            min_b = min(b.shape[0] for b in buf_x)  # ragged final batch guard
-            x = jnp.asarray(np.stack([b[:min_b] for b in buf_x]))   # [n, b, ...]
-            y = jnp.asarray(np.stack([b[:min_b] for b in buf_y]), model._dtype)
-            buf_x, buf_y = [], []
+            x = stack_buf("x")
+            y = stack_buf("y", model._dtype)
+            mask = stack_buf("m", model._dtype)
+            lmask = stack_buf("lm", model._dtype)
+            for k in bufs:
+                bufs[k] = []
             model._rng, sub = jax.random.split(model._rng)
             rngs = jax.random.split(sub, n)
-            params, opt_state, states, scores, _ = vstep(
-                params, opt_state, states, rngs, x, y)
+            params, opt_state, states, scores = run_step(
+                params, opt_state, states, rngs, x, y, mask, lmask)
             score = float(jnp.mean(scores))
             iters_since_avg += 1
             if iters_since_avg >= self.averaging_frequency:
@@ -231,3 +288,17 @@ class ParameterServerParallelWrapper:
     @staticmethod
     def builder(model):
         return ParameterServerParallelWrapper.Builder(model)
+
+def _concat_datasets(a, b):
+    """Concatenate two DataSets along the batch axis (mask-aware; masks must
+    be consistently present or absent)."""
+    from ..datasets.dataset import DataSet
+    cat = lambda u, v: None if u is None and v is None else np.concatenate(
+        [np.asarray(u), np.asarray(v)])
+    if (a.features_mask is None) != (b.features_mask is None) or \
+            (a.labels_mask is None) != (b.labels_mask is None):
+        raise ValueError("cannot concatenate DataSets with inconsistent masks")
+    return DataSet(np.concatenate([np.asarray(a.features), np.asarray(b.features)]),
+                   np.concatenate([np.asarray(a.labels), np.asarray(b.labels)]),
+                   cat(a.features_mask, b.features_mask),
+                   cat(a.labels_mask, b.labels_mask))

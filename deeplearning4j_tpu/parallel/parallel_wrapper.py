@@ -81,10 +81,16 @@ class ParallelWrapper:
         it = as_iterator(iterator)
         if self.prefetch_buffer and it.async_supported():
             it = AsyncDataSetIterator(it, queue_size=self.prefetch_buffer)
+        trained = 0
         for _ in range(epochs):
             it.reset()
             for ds in it:
-                self.trainer.fit_batch(ds)
+                if self.trainer.fit_batch(ds) is not None:
+                    trained += 1
+        if trained == 0:
+            raise ValueError(
+                f"no batch was large enough for the {self.workers}-way data "
+                f"axis — nothing trained; increase batch_size or reduce workers")
         return self.model
 
     def shutdown(self):

@@ -177,11 +177,19 @@ class ShardedTrainer:
 
     def fit_batch(self, ds):
         """One globally-batched step: the batch is split over the data axis;
-        XLA all-reduces gradients over ICI."""
+        XLA all-reduces gradients over ICI. Returns None (no step) when the
+        batch is smaller than the data axis."""
         m = self.model
         ds = self._trim(ds)
         if ds is None:
-            return m.score_value
+            import warnings
+            if not getattr(self, "_warned_small_batch", False):
+                self._warned_small_batch = True
+                warnings.warn(
+                    f"batch smaller than the {self.mesh.shape[DATA_AXIS]}-way "
+                    f"data axis was skipped; increase batch_size or reduce "
+                    f"workers", stacklevel=2)
+            return None
         if self._step is None:
             self._step = self._build_step()
         from ..nn.multilayer.network import MultiLayerNetwork
@@ -224,8 +232,15 @@ class ShardedTrainer:
     def fit(self, iterator, epochs=1):
         from ..datasets.iterator.base import as_iterator  # type: ignore
         it = as_iterator(iterator) if not hasattr(iterator, "reset") else iterator
+        trained = 0
         for _ in range(epochs):
             it.reset()
             for ds in it:
-                self.fit_batch(ds)
+                if self.fit_batch(ds) is not None:
+                    trained += 1
+        if trained == 0:
+            raise ValueError(
+                f"no batch was large enough for the "
+                f"{self.mesh.shape[DATA_AXIS]}-way data axis — nothing "
+                f"trained; increase batch_size or reduce workers")
         return self.model

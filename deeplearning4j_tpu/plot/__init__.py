@@ -1,0 +1,5 @@
+"""Embedding/visualization algorithms (reference: deeplearning4j-core plot/ —
+BarnesHutTsne.java 850 LoC, Tsne.java)."""
+from .tsne import BarnesHutTsne, Tsne
+
+__all__ = ["BarnesHutTsne", "Tsne"]

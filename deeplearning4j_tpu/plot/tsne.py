@@ -1,0 +1,249 @@
+"""t-SNE embedding.
+
+Reference: deeplearning4j-core plot/BarnesHutTsne.java (850 LoC; perplexity
+binary search over conditional Gaussians, early exaggeration, momentum
+gradient descent, Barnes-Hut O(N log N) force approximation via SpTree +
+VPTree-kNN sparse input similarities) and plot/Tsne.java (exact O(N^2)).
+
+TPU-first split: the exact path runs the WHOLE gradient loop as jitted XLA
+(pairwise matrices are MXU-friendly; N<=a few thousand fits easily) — this is
+the default and is typically faster on accelerators than Barnes-Hut up to
+~10k points. The Barnes-Hut path (theta>0) keeps the reference's host-side
+tree algorithm for very large N.
+"""
+from __future__ import annotations
+
+import functools
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+
+def _hbeta(d_row, beta):
+    p = np.exp(-d_row * beta)
+    sum_p = max(p.sum(), 1e-12)
+    h = np.log(sum_p) + beta * (d_row @ p) / sum_p
+    return h, p / sum_p
+
+
+def _binary_search_perplexity(D, perplexity, tol=1e-5, max_tries=50):
+    """Per-row beta search so each conditional distribution has the requested
+    perplexity (reference: BarnesHutTsne.computeGaussianPerplexity)."""
+    n = D.shape[0]
+    target = np.log(perplexity)
+    P = np.zeros_like(D)
+    for i in range(n):
+        beta, beta_min, beta_max = 1.0, -np.inf, np.inf
+        d_row = D[i].copy()
+        d_row[i] = 0.0
+        for _ in range(max_tries):
+            h, p = _hbeta(d_row, beta)
+            if abs(h - target) < tol:
+                break
+            if h > target:
+                beta_min = beta
+                beta = beta * 2 if beta_max == np.inf else (beta + beta_max) / 2
+            else:
+                beta_max = beta
+                beta = beta / 2 if beta_min == -np.inf else (beta + beta_min) / 2
+        p[i] = 0.0
+        P[i] = p
+    return P
+
+
+@functools.partial(jax.jit, static_argnames=("n_iter", "switch_momentum"))
+def _tsne_loop(P, Y0, lr, n_iter, early_exaggeration, switch_momentum):
+    """Exact-gradient t-SNE loop compiled as one XLA while-program."""
+    def grad(P_eff, Y):
+        sum_y = jnp.sum(Y ** 2, 1)
+        num = 1.0 / (1.0 + sum_y[:, None] + sum_y[None, :] -
+                     2.0 * (Y @ Y.T))                           # student-t kernel
+        num = num.at[jnp.diag_indices(Y.shape[0])].set(0.0)
+        Q = num / jnp.maximum(num.sum(), 1e-12)
+        PQ = P_eff - jnp.maximum(Q, 1e-12)
+        W = PQ * num
+        # grad_i = 4 * sum_j W_ij (y_i - y_j)
+        g = 4.0 * (W.sum(1)[:, None] * Y - W @ Y)
+        return g
+
+    def body(i, state):
+        Y, vel, gains = state
+        momentum = jnp.where(i < switch_momentum, 0.5, 0.8)
+        exag = jnp.where(i < switch_momentum, early_exaggeration, 1.0)
+        g = grad(P * exag, Y)
+        gains = jnp.where(jnp.sign(g) != jnp.sign(vel),
+                          gains + 0.2, gains * 0.8)
+        gains = jnp.maximum(gains, 0.01)
+        vel = momentum * vel - lr * gains * g
+        Y = Y + vel
+        Y = Y - Y.mean(0)
+        return Y, vel, gains
+
+    vel = jnp.zeros_like(Y0)
+    gains = jnp.ones_like(Y0)
+    Y, _, _ = jax.lax.fori_loop(0, n_iter, body, (Y0, vel, gains))
+    return Y
+
+
+class Tsne:
+    """Exact t-SNE (reference: plot/Tsne.java). Builder-compatible with the
+    reference's Tsne.Builder."""
+
+    def __init__(self, n_components=2, perplexity=30.0, learning_rate=200.0,
+                 n_iter=1000, early_exaggeration=12.0, seed=0, theta=0.0):
+        self.n_components = n_components
+        self.perplexity = perplexity
+        self.learning_rate = learning_rate
+        self.n_iter = n_iter
+        self.early_exaggeration = early_exaggeration
+        self.seed = seed
+        self.theta = theta
+        self.Y = None
+
+    class Builder:
+        def __init__(self):
+            self._kw = {}
+
+        def perplexity(self, p):
+            self._kw["perplexity"] = p
+            return self
+
+        def learning_rate(self, lr):
+            self._kw["learning_rate"] = lr
+            return self
+
+        def set_max_iter(self, n):
+            self._kw["n_iter"] = n
+            return self
+
+        def theta(self, t):
+            self._kw["theta"] = t
+            return self
+
+        def seed(self, s):
+            self._kw["seed"] = s
+            return self
+
+        def build(self):
+            return Tsne(**self._kw)
+
+    @staticmethod
+    def builder():
+        return Tsne.Builder()
+
+    def _input_similarities(self, X):
+        X = np.asarray(X, np.float64)
+        sum_x = (X ** 2).sum(1)
+        D = np.maximum(sum_x[:, None] + sum_x[None] - 2 * X @ X.T, 0.0)
+        P = _binary_search_perplexity(D, self.perplexity)
+        P = P + P.T
+        P = P / max(P.sum(), 1e-12)
+        return np.maximum(P, 1e-12)
+
+    def fit_transform(self, X):
+        n = len(X)
+        P = jnp.asarray(self._input_similarities(X), jnp.float32)
+        rng = np.random.default_rng(self.seed)
+        Y0 = jnp.asarray(rng.normal(scale=1e-4,
+                                    size=(n, self.n_components)),
+                         jnp.float32)
+        switch = min(250, self.n_iter // 4)
+        self.Y = np.asarray(_tsne_loop(P, Y0, self.learning_rate, self.n_iter,
+                                       self.early_exaggeration, switch))
+        return self.Y
+
+    fit = fit_transform
+
+
+class BarnesHutTsne(Tsne):
+    """Barnes-Hut t-SNE (reference: plot/BarnesHutTsne.java). theta controls
+    the accuracy/speed tradeoff; theta=0 delegates to the exact compiled
+    path, theta>0 runs the host-side SpTree approximation with VPTree-kNN
+    sparse similarities (3*perplexity neighbours like the reference)."""
+
+    def __init__(self, n_components=2, perplexity=30.0, learning_rate=200.0,
+                 n_iter=1000, early_exaggeration=12.0, seed=0, theta=0.5):
+        super().__init__(n_components, perplexity, learning_rate, n_iter,
+                         early_exaggeration, seed, theta)
+
+    def fit_transform(self, X):
+        if self.theta <= 0:
+            return super().fit_transform(X)
+        return self._fit_bh(np.asarray(X, np.float64))
+
+    fit = fit_transform
+
+    def _sparse_similarities(self, X):
+        from ..clustering.vptree import VPTree
+        n = len(X)
+        k = min(n - 1, int(3 * self.perplexity))
+        tree = VPTree(X, seed=self.seed)
+        rows, cols, vals = [], [], []
+        target = np.log(self.perplexity)
+        for i in range(n):
+            idxs, dists = tree.search(X[i], k + 1)
+            pairs = [(j, d) for j, d in zip(idxs, dists) if j != i][:k]
+            js = np.array([j for j, _ in pairs])
+            d2 = np.array([d for _, d in pairs]) ** 2
+            beta, bmin, bmax = 1.0, -np.inf, np.inf
+            for _ in range(50):
+                p = np.exp(-d2 * beta)
+                sp = max(p.sum(), 1e-12)
+                h = np.log(sp) + beta * (d2 @ p) / sp
+                if abs(h - target) < 1e-5:
+                    break
+                if h > target:
+                    bmin = beta
+                    beta = beta * 2 if bmax == np.inf else (beta + bmax) / 2
+                else:
+                    bmax = beta
+                    beta = beta / 2 if bmin == -np.inf else (beta + bmin) / 2
+            p = p / max(p.sum(), 1e-12)
+            rows.extend([i] * len(js))
+            cols.extend(js.tolist())
+            vals.extend(p.tolist())
+        # symmetrize
+        P = {}
+        for r, c, v in zip(rows, cols, vals):
+            P[(r, c)] = P.get((r, c), 0.0) + v
+            P[(c, r)] = P.get((c, r), 0.0) + v
+        total = sum(P.values())
+        return {k2: v / total for k2, v in P.items()}
+
+    def _fit_bh(self, X):
+        from ..clustering.sptree import SpTree
+        n = len(X)
+        P = self._sparse_similarities(X)
+        edges = [[] for _ in range(n)]
+        for (i, j), v in P.items():
+            edges[i].append((j, v))
+        rng = np.random.default_rng(self.seed)
+        Y = rng.normal(scale=1e-4, size=(n, self.n_components))
+        vel = np.zeros_like(Y)
+        gains = np.ones_like(Y)
+        switch = min(250, self.n_iter // 4)
+        for it in range(self.n_iter):
+            exag = self.early_exaggeration if it < switch else 1.0
+            momentum = 0.5 if it < switch else 0.8
+            tree = SpTree(Y)
+            pos_f = np.zeros_like(Y)
+            neg_f = np.zeros_like(Y)
+            z = 0.0
+            for i in range(n):
+                nf = np.zeros(self.n_components)
+                z += tree.compute_non_edge_forces(Y[i], self.theta, nf)
+                neg_f[i] = nf
+                for j, p in edges[i]:
+                    diff = Y[i] - Y[j]
+                    q = 1.0 / (1.0 + diff @ diff)
+                    pos_f[i] += exag * p * q * diff
+            g = pos_f - neg_f / max(z, 1e-12)
+            gains = np.where(np.sign(g) != np.sign(vel), gains + 0.2,
+                             gains * 0.8)
+            gains = np.maximum(gains, 0.01)
+            vel = momentum * vel - self.learning_rate * gains * g
+            Y = Y + vel
+            Y = Y - Y.mean(0)
+        self.Y = Y
+        return Y

@@ -1,0 +1,114 @@
+"""Concurrency utilities.
+
+Reference: deeplearning4j-core parallelism/ — MagicQueue.java (multi-device
+batch distribution queue: one bounded queue per device, round-robin put,
+device-affine take), AsyncIterator.java (background-thread prefetch over any
+iterator), ConcurrentHashSet.java.
+
+On TPU the JAX dispatch queue already overlaps host and device work; these
+remain useful for host-side input pipelines feeding multiple logical shards.
+"""
+from __future__ import annotations
+
+import queue
+import threading
+
+
+class MagicQueue:
+    """Round-robin distribution of items to per-worker bounded queues
+    (reference: parallelism/MagicQueue.java — mode SEQUENTIAL round-robin)."""
+
+    _SENTINEL = object()
+
+    def __init__(self, n_workers, capacity=8):
+        self.n_workers = int(n_workers)
+        self._queues = [queue.Queue(maxsize=capacity)
+                        for _ in range(self.n_workers)]
+        self._put_idx = 0
+        self._lock = threading.Lock()
+
+    def add(self, item):
+        with self._lock:
+            idx = self._put_idx
+            self._put_idx = (self._put_idx + 1) % self.n_workers
+        self._queues[idx].put(item)
+
+    put = add
+
+    def poll(self, worker, timeout=None):
+        """Take the next item for `worker` (device-affine take)."""
+        try:
+            item = self._queues[worker].get(timeout=timeout)
+        except queue.Empty:
+            return None
+        return None if item is self._SENTINEL else item
+
+    def size(self, worker=None):
+        if worker is not None:
+            return self._queues[worker].qsize()
+        return sum(q.qsize() for q in self._queues)
+
+    def close(self):
+        for q in self._queues:
+            q.put(self._SENTINEL)
+
+
+class AsyncIterator:
+    """Background-thread prefetch over any iterator (reference:
+    parallelism/AsyncIterator.java)."""
+
+    _SENTINEL = object()
+
+    def __init__(self, iterator, buffer_size=8):
+        self._queue = queue.Queue(maxsize=buffer_size)
+        self._error = None
+
+        def run():
+            try:
+                for item in iterator:
+                    self._queue.put(item)
+            except BaseException as e:  # propagate to consumer
+                self._error = e
+            finally:
+                self._queue.put(self._SENTINEL)
+
+        self._thread = threading.Thread(target=run, daemon=True)
+        self._thread.start()
+
+    def __iter__(self):
+        return self
+
+    def __next__(self):
+        item = self._queue.get()
+        if item is self._SENTINEL:
+            if self._error is not None:
+                raise self._error
+            raise StopIteration
+        return item
+
+
+class ConcurrentHashSet:
+    """(reference: parallelism/ConcurrentHashSet.java)"""
+
+    def __init__(self):
+        self._set = set()
+        self._lock = threading.Lock()
+
+    def add(self, item):
+        with self._lock:
+            if item in self._set:
+                return False
+            self._set.add(item)
+            return True
+
+    def remove(self, item):
+        with self._lock:
+            self._set.discard(item)
+
+    def __contains__(self, item):
+        with self._lock:
+            return item in self._set
+
+    def __len__(self):
+        with self._lock:
+            return len(self._set)

@@ -1,0 +1,206 @@
+"""Tests for clustering, t-SNE, dataset fetchers, concurrency utils —
+mirroring the reference's deeplearning4j-core test suites (KMeansTest,
+KDTreeTest, VPTreeTest, TsneTest, dataset iterator tests)."""
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu.clustering import (KMeansClustering, KDTree, VPTree,
+                                           SpTree, Point)
+from deeplearning4j_tpu.plot import BarnesHutTsne, Tsne
+from deeplearning4j_tpu.datasets.fetchers.standard import (
+    IrisDataSetIterator, CifarDataSetIterator, LFWDataSetIterator,
+    CurvesDataSetIterator)
+from deeplearning4j_tpu.util.concurrency import (MagicQueue, AsyncIterator,
+                                                 ConcurrentHashSet)
+
+
+def _blobs(n_per=40, seed=0):
+    rng = np.random.default_rng(seed)
+    cs = np.array([[0, 0], [8, 8], [0, 8]])
+    x = np.concatenate([c + rng.normal(size=(n_per, 2)) for c in cs])
+    y = np.repeat(np.arange(3), n_per)
+    return x.astype(np.float32), y
+
+
+# ------------------------------------------------------------- clustering
+
+def test_kmeans_recovers_blobs():
+    x, y = _blobs()
+    km = KMeansClustering.setup(3, max_iterations=50, seed=1)
+    cs = km.apply_to(x)
+    assign = cs.assignments
+    # purity: every true cluster maps dominantly to one k-means cluster
+    purity = 0
+    for c in range(3):
+        labels, counts = np.unique(assign[y == c], return_counts=True)
+        purity += counts.max()
+    assert purity / len(x) > 0.95
+    # nearest_cluster works
+    assert cs.nearest_cluster([8, 8]).id == assign[y == 1][0]
+
+
+def test_kmeans_point_objects():
+    x, _ = _blobs(10)
+    pts = [Point(row, point_id=i) for i, row in enumerate(x)]
+    cs = KMeansClustering(3, seed=0).apply_to(pts)
+    assert sum(len(c.points) for c in cs.get_clusters()) == len(pts)
+
+
+def test_kdtree_knn_matches_bruteforce():
+    rng = np.random.default_rng(2)
+    pts = rng.normal(size=(200, 5))
+    tree = KDTree(points=pts)
+    q = rng.normal(size=5)
+    d = np.linalg.norm(pts - q, axis=1)
+    expect = set(np.argsort(d)[:7])
+    got = {idx for _, _, idx in tree.knn(q, 7)}
+    assert got == expect
+    nn = tree.nn(q)
+    assert nn[2] == int(np.argmin(d))
+
+
+def test_kdtree_insert():
+    tree = KDTree(dims=2)
+    for i, p in enumerate([[0, 0], [1, 1], [2, 2], [0.1, 0.1]]):
+        tree.insert(p, i)
+    assert tree.size == 4
+    assert tree.nn([0.05, 0.05])[2] in (0, 3)
+
+
+def test_vptree_knn_matches_bruteforce():
+    rng = np.random.default_rng(3)
+    pts = rng.normal(size=(150, 8))
+    tree = VPTree(pts, seed=4)
+    q = rng.normal(size=8)
+    d = np.linalg.norm(pts - q, axis=1)
+    expect = list(np.argsort(d)[:5])
+    idxs, dists = tree.search(q, 5)
+    assert idxs == expect
+    np.testing.assert_allclose(dists, np.sort(d)[:5], rtol=1e-9)
+
+
+def test_sptree_mass_and_forces():
+    rng = np.random.default_rng(4)
+    pts = rng.normal(size=(50, 2))
+    tree = SpTree(pts)
+    assert tree.cum_size == 50
+    np.testing.assert_allclose(tree.center_of_mass, pts.mean(0), rtol=1e-9)
+    # theta=0 forces == exact O(N^2) computation
+    q = pts[0]
+    neg = np.zeros(2)
+    z = tree.compute_non_edge_forces(q, 0.0, neg)
+    diff = q[None] - pts[1:]
+    qk = 1.0 / (1.0 + (diff ** 2).sum(1))
+    z_exact = qk.sum()
+    neg_exact = (qk[:, None] ** 2 * diff).sum(0)
+    np.testing.assert_allclose(z, z_exact, rtol=1e-6)
+    np.testing.assert_allclose(neg, neg_exact, rtol=1e-6)
+
+
+# ------------------------------------------------------------------ t-SNE
+
+def test_tsne_exact_separates_blobs():
+    x, y = _blobs(25, seed=5)
+    ts = Tsne(perplexity=15.0, n_iter=300, seed=6)
+    Y = ts.fit_transform(x)
+    assert Y.shape == (75, 2)
+    # cluster separation in the embedding: mean inter-centroid distance
+    # exceeds mean intra-cluster spread
+    cents = np.stack([Y[y == c].mean(0) for c in range(3)])
+    intra = np.mean([np.linalg.norm(Y[y == c] - cents[c], axis=1).mean()
+                     for c in range(3)])
+    inter = np.mean([np.linalg.norm(cents[a] - cents[b])
+                     for a in range(3) for b in range(a + 1, 3)])
+    assert inter > 2 * intra
+
+
+def test_tsne_barnes_hut_separates_blobs():
+    x, y = _blobs(20, seed=7)
+    ts = BarnesHutTsne(perplexity=10.0, n_iter=250, theta=0.5, seed=8)
+    Y = ts.fit_transform(x)
+    assert Y.shape == (60, 2)
+    cents = np.stack([Y[y == c].mean(0) for c in range(3)])
+    intra = np.mean([np.linalg.norm(Y[y == c] - cents[c], axis=1).mean()
+                     for c in range(3)])
+    inter = np.mean([np.linalg.norm(cents[a] - cents[b])
+                     for a in range(3) for b in range(a + 1, 3)])
+    assert inter > 1.5 * intra
+
+
+# --------------------------------------------------------------- fetchers
+
+def test_iris_iterator():
+    it = IrisDataSetIterator(batch_size=50)
+    ds = it.next()
+    assert ds.features.shape == (50, 4)
+    assert ds.labels.shape == (50, 3)
+    total = 50
+    while it.has_next():
+        total += it.next().num_examples()
+    assert total == 150
+
+
+def test_cifar_iterator_trains():
+    from deeplearning4j_tpu import (NeuralNetConfiguration, InputType,
+                                    ConvolutionLayer, SubsamplingLayer,
+                                    OutputLayer, MultiLayerNetwork, Adam)
+    it = CifarDataSetIterator(batch_size=32, num_examples=128)
+    ds = it.next()
+    assert ds.features.shape == (32, 32, 32, 3)
+    conf = (NeuralNetConfiguration.builder().seed(1).updater(Adam(1e-2)).list()
+            .layer(ConvolutionLayer(kernel_size=(3, 3), n_out=8,
+                                    activation="relu", convolution_mode="same"))
+            .layer(SubsamplingLayer(kernel_size=(4, 4), stride=(4, 4)))
+            .layer(OutputLayer(n_out=10, activation="softmax", loss="MCXENT"))
+            .set_input_type(InputType.convolutional(32, 32, 3))
+            .build())
+    net = MultiLayerNetwork(conf).init()
+    net.fit(it, epochs=3)
+    assert np.isfinite(net.score_value)
+
+
+def test_lfw_and_curves_iterators():
+    lfw = LFWDataSetIterator(batch_size=8, num_examples=32,
+                             image_size=(16, 16), num_labels=4)
+    ds = lfw.next()
+    assert ds.features.shape == (8, 16, 16, 3)
+    assert ds.labels.shape == (8, 4)
+    curves = CurvesDataSetIterator(batch_size=16, num_examples=64)
+    ds = curves.next()
+    assert ds.features.shape == (16, 784)
+    np.testing.assert_array_equal(ds.features, ds.labels)  # autoencoder target
+
+
+# ------------------------------------------------------------ concurrency
+
+def test_magic_queue_round_robin():
+    mq = MagicQueue(3)
+    for i in range(6):
+        mq.add(i)
+    assert mq.poll(0) == 0 and mq.poll(0) == 3
+    assert mq.poll(1) == 1 and mq.poll(2) == 2
+    assert mq.size() == 2
+
+
+def test_async_iterator():
+    out = list(AsyncIterator(iter(range(100)), buffer_size=4))
+    assert out == list(range(100))
+
+
+def test_async_iterator_propagates_errors():
+    def gen():
+        yield 1
+        raise RuntimeError("boom")
+    it = AsyncIterator(gen())
+    assert next(it) == 1
+    with pytest.raises(RuntimeError, match="boom"):
+        next(it)
+        next(it)
+
+
+def test_concurrent_hash_set():
+    s = ConcurrentHashSet()
+    assert s.add("a") and not s.add("a")
+    assert "a" in s and len(s) == 1
+    s.remove("a")
+    assert len(s) == 0

@@ -216,6 +216,72 @@ def test_parameter_server_facade_delegates():
     assert net.score(x, y) < s0
 
 
+def test_training_master_averaging_computation_graph():
+    from deeplearning4j_tpu import ComputationGraph
+    conf = (NeuralNetConfiguration.builder().seed(6).updater(Sgd(0.1))
+            .graph_builder()
+            .add_inputs("in")
+            .add_layer("d", DenseLayer(n_out=8, activation="tanh"), "in")
+            .add_layer("out", OutputLayer(n_out=2, activation="softmax",
+                                          loss="MCXENT"), "d")
+            .set_outputs("out")
+            .set_input_types(InputType.feed_forward(4))
+            .build())
+    g = ComputationGraph(conf).init()
+    x, y = _toy(6)
+    s0 = g.score(DataSet(x, y))
+    tm = (ParameterAveragingTrainingMaster.builder(16)
+          .worker_count(4).mode("averaging").build())
+    for _ in range(4):
+        tm.execute_training(g, ListDataSetIterator(DataSet(x, y), batch_size=16))
+    assert g.score(DataSet(x, y)) < s0
+
+
+def test_training_master_averaging_passes_masks():
+    """Masked recurrent training in averaging mode must honor the masks."""
+    from deeplearning4j_tpu import GravesLSTM, RnnOutputLayer
+    rng = np.random.default_rng(11)
+    b, t = 32, 6
+    x = rng.normal(size=(b, t, 3)).astype(np.float32)
+    y = np.eye(2, dtype=np.float32)[rng.integers(0, 2, (b, t))]
+    mask = np.ones((b, t), np.float32)
+    mask[:, 4:] = 0
+    conf = (NeuralNetConfiguration.builder().seed(12).updater(Sgd(0.05)).list()
+            .layer(GravesLSTM(n_out=8, activation="tanh"))
+            .layer(RnnOutputLayer(n_out=2, activation="softmax", loss="MCXENT"))
+            .set_input_type(InputType.recurrent(3))
+            .build())
+    net = MultiLayerNetwork(conf).init()
+    ds = DataSet(x, y, features_mask=mask, labels_mask=mask)
+    tm = (ParameterAveragingTrainingMaster.builder(8)
+          .worker_count(4).mode("averaging").build())
+    s0 = net.score(ds)
+    for _ in range(3):
+        tm.execute_training(net, ListDataSetIterator(ds, batch_size=8))
+    assert net.score(ds) < s0
+
+
+def test_training_master_rebatches_to_worker_batch_size():
+    x, y = _toy(8, n=96)
+    net = _net(seed=8)
+    tm = (ParameterAveragingTrainingMaster.builder(4)   # 4/worker * 8 = 32 global
+          .worker_count(8).mode("allreduce").build())
+    s0 = net.score(x, y)
+    # upstream iterator uses a mismatched batch size; master re-cuts it
+    tm.execute_training(net, ListDataSetIterator(DataSet(x, y), batch_size=50))
+    assert net.score(x, y) < s0
+
+
+def test_sharded_trainer_raises_when_nothing_trains():
+    from deeplearning4j_tpu.parallel.parallel_wrapper import ParallelWrapper
+    x, y = _toy(9, n=16)
+    net = _net(seed=9)
+    pw = ParallelWrapper.builder(net).workers(8).build()
+    with pytest.raises(ValueError, match="nothing"):
+        # every batch (4 examples) is smaller than the 8-way data axis
+        pw.fit(ListDataSetIterator(DataSet(x, y), batch_size=4))
+
+
 def test_early_stopping_parallel_trainer():
     from deeplearning4j_tpu.earlystopping import (
         EarlyStoppingConfiguration, MaxEpochsTerminationCondition,
