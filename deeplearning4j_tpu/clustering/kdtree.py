@@ -80,14 +80,18 @@ class KDTree:
         query = np.asarray(query, np.float64)
         heap = []  # max-heap by -dist
 
+        points = {}
+
         def visit(node, depth):
             if node is None:
                 return
             d = float(np.linalg.norm(node.point - query))
+            points[node.idx] = node.point
+            # tuples compare (dist, idx) only — never the point arrays
             if len(heap) < k:
-                heapq.heappush(heap, (-d, node.idx, node.point))
+                heapq.heappush(heap, (-d, node.idx))
             elif d < -heap[0][0]:
-                heapq.heapreplace(heap, (-d, node.idx, node.point))
+                heapq.heapreplace(heap, (-d, node.idx))
             axis = depth % self.dims
             diff = query[axis] - node.point[axis]
             near, far = (node.left, node.right) if diff < 0 else (node.right, node.left)
@@ -96,4 +100,4 @@ class KDTree:
                 visit(far, depth + 1)
 
         visit(self.root, 0)
-        return sorted([(-h[0], h[2], h[1]) for h in heap])
+        return [(d, points[i], i) for d, i in sorted((-hd, i) for hd, i in heap)]

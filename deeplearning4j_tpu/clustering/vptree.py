@@ -12,13 +12,14 @@ import numpy as np
 
 
 class _VPNode:
-    __slots__ = ("idx", "threshold", "inside", "outside")
+    __slots__ = ("idx", "threshold", "inside", "outside", "bucket")
 
     def __init__(self, idx):
         self.idx = idx
         self.threshold = 0.0
         self.inside = None
         self.outside = None
+        self.bucket = None   # leaf bucket for degenerate splits
 
 
 class VPTree:
@@ -50,6 +51,12 @@ class VPTree:
         node.threshold = median
         inside = [i for i, d in zip(rest, dists) if d < median]
         outside = [i for i, d in zip(rest, dists) if d >= median]
+        if not inside or not outside:
+            # degenerate split (duplicate-heavy data: every distance equals
+            # the median) — store the rest as a linearly-scanned leaf bucket
+            # instead of recursing O(n) deep
+            node.bucket = rest
+            return node
         node.inside = self._build(inside)
         node.outside = self._build(outside)
         return node
@@ -60,17 +67,24 @@ class VPTree:
         heap = []  # (-dist, idx) max-heap
         tau = [np.inf]
 
+        def consider(i, d):
+            if len(heap) < k:
+                heapq.heappush(heap, (-d, i))
+                if len(heap) == k:
+                    tau[0] = -heap[0][0]
+            elif d < tau[0]:
+                heapq.heapreplace(heap, (-d, i))
+                tau[0] = -heap[0][0]
+
         def visit(node):
             if node is None:
                 return
             d = self._dist(self.points[node.idx], query)
-            if len(heap) < k:
-                heapq.heappush(heap, (-d, node.idx))
-                if len(heap) == k:
-                    tau[0] = -heap[0][0]
-            elif d < tau[0]:
-                heapq.heapreplace(heap, (-d, node.idx))
-                tau[0] = -heap[0][0]
+            consider(node.idx, d)
+            if node.bucket is not None:
+                for i in node.bucket:
+                    consider(i, self._dist(self.points[i], query))
+                return
             if node.inside is None and node.outside is None:
                 return
             if d < node.threshold:

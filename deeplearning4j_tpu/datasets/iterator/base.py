@@ -308,4 +308,6 @@ def as_iterator(data, batch_size=None):
         return INDArrayDataSetIterator(data[0], data[1], batch_size or len(np.asarray(data[0])))
     if isinstance(data, (list, tuple)):
         return ListDataSetIterator(list(data))
+    if hasattr(data, "reset") and hasattr(data, "__iter__"):
+        return data  # duck-typed iterator (e.g. streaming rebatch wrappers)
     raise TypeError(f"Cannot convert {type(data)} to DataSetIterator")

@@ -45,6 +45,7 @@ class MultiLayerNetwork:
         self.iteration_count = 0
         self.epoch_count = 0
         self.score_value = float("nan")
+        self.last_gradients = None   # most recent step's gradients (StatsListener)
         self._dtype = jnp.dtype(conf.dtype)
         self._rng = jax.random.PRNGKey(conf.seed)
         self._rnn_state = {}        # streaming inference carries per layer idx
@@ -192,7 +193,7 @@ class MultiLayerNetwork:
             grads = self._normalize_grads(grads)
             updates, opt_state = tx.update(grads, opt_state, params)
             params = optax.apply_updates(params, updates)
-            return params, opt_state, new_states, score, out_carries
+            return params, opt_state, new_states, score, out_carries, grads
 
         return jax.jit(train_step, donate_argnums=(0, 1, 2))
 
@@ -247,13 +248,19 @@ class MultiLayerNetwork:
             self._fit_tbptt(x, y, mask, lmask, step_rng)
         else:
             step = self._get_train_step("std")
-            self.params, self.opt_state, self.states, score, _ = step(
+            (self.params, self.opt_state, self.states, score, _,
+             self.last_gradients) = step(
                 self.params, self.opt_state, self.states, step_rng, x, y, mask,
                 lmask, None)
             self.score_value = float(score)
         self.iteration_count += 1
         for listener in self.listeners:
+            if hasattr(listener, "record_batch_size"):
+                listener.record_batch_size(x.shape[0])
             listener.iteration_done(self, self.iteration_count)
+        if not any(getattr(l, "wants_gradients", False) for l in self.listeners):
+            # don't pin a params-sized gradient pytree on device between steps
+            self.last_gradients = None
 
     def _fit_tbptt(self, x, y, mask, lmask, rng):
         """Truncated BPTT (reference: doTruncatedBPTT :1064): slide a window of
@@ -271,7 +278,8 @@ class MultiLayerNetwork:
             mw = mask[:, start:end] if mask is not None else None
             lmw = lmask[:, start:end] if lmask is not None else None
             rng, sub = jax.random.split(rng)
-            self.params, self.opt_state, self.states, score, carries = step(
+            (self.params, self.opt_state, self.states, score, carries,
+             self.last_gradients) = step(
                 self.params, self.opt_state, self.states, sub, xw, yw, mw, lmw, carries)
             carries = jax.tree_util.tree_map(jax.lax.stop_gradient, carries)
             scores.append(float(score))

@@ -113,9 +113,9 @@ class ParameterAveragingTrainingMaster(TrainingMaster):
                 self._pw = ParallelWrapper(model, workers=self.worker_count,
                                            devices=self.devices)
             n = self._pw.workers
-            batches = list(self._rebatched(data_iterator,
-                                           self.batch_size_per_worker * n))
-            self._pw.fit(batches)
+            self._pw.fit(_GeneratorIterator(
+                lambda: self._rebatched(data_iterator,
+                                        self.batch_size_per_worker * n)))
             return model
         return self._execute_averaging(model, data_iterator)
 
@@ -163,6 +163,10 @@ class ParameterAveragingTrainingMaster(TrainingMaster):
         score = float("nan")
 
         def push(ds):
+            if isinstance(ds.features, list) and len(ds.features) > 1:
+                raise NotImplementedError(
+                    "averaging mode supports single-input/single-output "
+                    "models; use mode='allreduce' for multi-input graphs")
             feats = ds.features[0] if isinstance(ds.features, list) else ds.features
             labels = ds.labels[0] if isinstance(ds.labels, list) else ds.labels
             fm = getattr(ds, "features_mask", None)
@@ -174,8 +178,12 @@ class ParameterAveragingTrainingMaster(TrainingMaster):
 
         def stack_buf(key, dtype=None):
             vals = bufs[key]
-            if any(v is None for v in vals):
+            if all(v is None for v in vals):
                 return None
+            if any(v is None for v in vals):
+                raise ValueError(
+                    "averaging window mixes masked and unmasked batches — "
+                    "masks must be consistently present or absent")
             min_b = min(v.shape[0] for v in vals)  # ragged final batch guard
             arr = np.stack([v[:min_b] for v in vals])
             return jnp.asarray(arr) if dtype is None else jnp.asarray(arr, dtype)
@@ -302,3 +310,25 @@ def _concat_datasets(a, b):
                    np.concatenate([np.asarray(a.labels), np.asarray(b.labels)]),
                    cat(a.features_mask, b.features_mask),
                    cat(a.labels_mask, b.labels_mask))
+
+
+class _GeneratorIterator:
+    """Streams batches from a generator factory with reset() support —
+    O(window) memory for the allreduce path (no full materialization)."""
+
+    def __init__(self, factory):
+        self._factory = factory
+        self._gen = None
+
+    def reset(self):
+        self._gen = self._factory()
+        return self
+
+    def async_supported(self):
+        return False
+
+    def __iter__(self):
+        if self._gen is None:
+            self.reset()
+        gen, self._gen = self._gen, None
+        return gen

@@ -205,7 +205,7 @@ class ShardedTrainer:
                     self._put_batch(ds.labels_mask, m._dtype)
                 out = self._step(m.params, m.opt_state, m.states, rng, x, y,
                                  mask, lmask, None)
-                m.params, m.opt_state, m.states, score, _ = out
+                m.params, m.opt_state, m.states, score, _, m.last_gradients = out
             else:
                 from ..datasets.dataset import MultiDataSet, DataSet as DS
                 if isinstance(ds, DS):

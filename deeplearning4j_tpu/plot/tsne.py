@@ -188,9 +188,7 @@ class BarnesHutTsne(Tsne):
             d2 = np.array([d for _, d in pairs]) ** 2
             beta, bmin, bmax = 1.0, -np.inf, np.inf
             for _ in range(50):
-                p = np.exp(-d2 * beta)
-                sp = max(p.sum(), 1e-12)
-                h = np.log(sp) + beta * (d2 @ p) / sp
+                h, p = _hbeta(d2, beta)   # shared with the exact path
                 if abs(h - target) < 1e-5:
                     break
                 if h > target:
@@ -199,7 +197,6 @@ class BarnesHutTsne(Tsne):
                 else:
                     bmax = beta
                     beta = beta / 2 if bmin == -np.inf else (beta + bmin) / 2
-            p = p / max(p.sum(), 1e-12)
             rows.extend([i] * len(js))
             cols.extend(js.tolist())
             vals.extend(p.tolist())

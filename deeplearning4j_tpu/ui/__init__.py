@@ -1,0 +1,19 @@
+"""Observability/UI stack (reference: deeplearning4j-ui-parent — stats
+collection, pluggable stats storage, embedded web UI with UIModule SPI,
+JSON chart/table components). See SURVEY.md §2.8.
+"""
+from .stats import StatsListener, StatsReport, StatsInitReport, ProfilerListener
+from .storage import (StatsStorageRouter, CollectionStatsStorageRouter,
+                      InMemoryStatsStorage, FileStatsStorage,
+                      RemoteUIStatsStorageRouter)
+from .server import (UIServer, UIModule, TrainModule, DefaultModule,
+                     RemoteReceiverModule)
+from . import components
+
+__all__ = [
+    "StatsListener", "StatsReport", "StatsInitReport", "ProfilerListener",
+    "StatsStorageRouter", "CollectionStatsStorageRouter",
+    "InMemoryStatsStorage", "FileStatsStorage", "RemoteUIStatsStorageRouter",
+    "UIServer", "UIModule", "TrainModule", "DefaultModule",
+    "RemoteReceiverModule", "components",
+]

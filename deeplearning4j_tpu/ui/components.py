@@ -1,0 +1,144 @@
+"""JSON-serializable UI component model.
+
+Reference: deeplearning4j-ui-components components/{chart,component,table,
+text,decorator}/ (2 163 LoC) — ChartLine, ChartScatter, ChartHistogram,
+ComponentTable, ComponentText, ComponentDiv, styles; serialized to JSON for
+arbitrary front-ends.
+"""
+from __future__ import annotations
+
+import json
+
+
+class Style:
+    def __init__(self, width=None, height=None, background_color=None,
+                 margin=None):
+        self.data = {k: v for k, v in {
+            "width": width, "height": height,
+            "backgroundColor": background_color, "margin": margin,
+        }.items() if v is not None}
+
+    def to_dict(self):
+        return dict(self.data)
+
+
+class Component:
+    TYPE = "Component"
+
+    def __init__(self, style=None, title=None):
+        self.style = style
+        self.title = title
+
+    def _base(self):
+        d = {"componentType": self.TYPE}
+        if self.title is not None:
+            d["title"] = self.title
+        if self.style is not None:
+            d["style"] = self.style.to_dict()
+        return d
+
+    def to_dict(self):
+        return self._base()
+
+    def to_json(self):
+        return json.dumps(self.to_dict())
+
+
+class ComponentText(Component):
+    TYPE = "ComponentText"
+
+    def __init__(self, text, **kw):
+        super().__init__(**kw)
+        self.text = text
+
+    def to_dict(self):
+        d = self._base()
+        d["text"] = self.text
+        return d
+
+
+class ComponentTable(Component):
+    TYPE = "ComponentTable"
+
+    def __init__(self, header=None, content=None, **kw):
+        super().__init__(**kw)
+        self.header = header or []
+        self.content = content or []
+
+    def to_dict(self):
+        d = self._base()
+        d["header"] = list(self.header)
+        d["content"] = [list(r) for r in self.content]
+        return d
+
+
+class ComponentDiv(Component):
+    TYPE = "ComponentDiv"
+
+    def __init__(self, *children, **kw):
+        super().__init__(**kw)
+        self.children = list(children)
+
+    def to_dict(self):
+        d = self._base()
+        d["components"] = [c.to_dict() for c in self.children]
+        return d
+
+
+class ChartLine(Component):
+    TYPE = "ChartLine"
+
+    def __init__(self, **kw):
+        super().__init__(**kw)
+        self.series = []  # (name, x, y)
+
+    def add_series(self, name, x, y):
+        self.series.append((name, [float(v) for v in x], [float(v) for v in y]))
+        return self
+
+    def to_dict(self):
+        d = self._base()
+        d["series"] = [{"name": n, "x": x, "y": y} for n, x, y in self.series]
+        return d
+
+
+class ChartScatter(ChartLine):
+    TYPE = "ChartScatter"
+
+
+class ChartHistogram(Component):
+    TYPE = "ChartHistogram"
+
+    def __init__(self, **kw):
+        super().__init__(**kw)
+        self.bins = []  # (lower, upper, y)
+
+    def add_bin(self, lower, upper, y):
+        self.bins.append((float(lower), float(upper), float(y)))
+        return self
+
+    def to_dict(self):
+        d = self._base()
+        d["bins"] = [{"lower": l, "upper": u, "y": y} for l, u, y in self.bins]
+        return d
+
+
+def component_from_dict(d):
+    table = {c.TYPE: c for c in
+             (ComponentText, ComponentTable, ComponentDiv, ChartLine,
+              ChartScatter, ChartHistogram)}
+    cls = table[d["componentType"]]
+    obj = cls.__new__(cls)
+    Component.__init__(obj, title=d.get("title"))
+    if cls is ComponentText:
+        obj.text = d["text"]
+    elif cls is ComponentTable:
+        obj.header = d.get("header", [])
+        obj.content = d.get("content", [])
+    elif cls is ComponentDiv:
+        obj.children = [component_from_dict(c) for c in d.get("components", [])]
+    elif cls in (ChartLine, ChartScatter):
+        obj.series = [(s["name"], s["x"], s["y"]) for s in d.get("series", [])]
+    elif cls is ChartHistogram:
+        obj.bins = [(b["lower"], b["upper"], b["y"]) for b in d.get("bins", [])]
+    return obj

@@ -1,0 +1,221 @@
+"""Training UI server.
+
+Reference: deeplearning4j-play play/PlayUIServer.java:120-152 (embedded Play
+HTTP server, UIModule SPI with routes + StatsStorage subscription, i18n,
+Scala templates) and modules module/{train/TrainModule.java,
+remote/RemoteReceiverModule.java, defaultModule/DefaultModule.java}.
+
+Redesign: the embedded Play framework becomes a stdlib http.server in a
+daemon thread serving the same shape of endpoints — JSON APIs per UIModule +
+one self-contained HTML page that polls /train/overview and draws the score
+chart on a <canvas> (no external assets; zero-egress friendly).
+"""
+from __future__ import annotations
+
+import json
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+from .storage import InMemoryStatsStorage
+
+
+class UIModule:
+    """SPI (reference: api/UIModule.java — getRoutes + storage subscription)."""
+
+    def routes(self):
+        """{(method, path): handler(query, body) -> (status, content_type, bytes)}"""
+        return {}
+
+    def on_attach(self, storage):
+        pass
+
+
+class DefaultModule(UIModule):
+    """Landing page (reference: module/defaultModule/DefaultModule.java)."""
+
+    def routes(self):
+        return {("GET", "/"): lambda q, b: (200, "text/html", _INDEX_HTML)}
+
+
+class TrainModule(UIModule):
+    """Training dashboard endpoints (reference: module/train/TrainModule.java
+    — overview/model/system endpoints backed by the subscribed storage)."""
+
+    def __init__(self):
+        self.storage = None
+
+    def on_attach(self, storage):
+        self.storage = storage
+
+    def routes(self):
+        return {
+            ("GET", "/train/sessions"): self._sessions,
+            ("GET", "/train/overview"): self._overview,
+            ("GET", "/train/model"): self._model,
+        }
+
+    def _json(self, obj):
+        return 200, "application/json", json.dumps(obj).encode()
+
+    def _sessions(self, query, body):
+        return self._json(self.storage.list_session_ids())
+
+    def _pick_session(self, query):
+        sid = query.get("sid")
+        ids = self.storage.list_session_ids()
+        if sid is None and ids:
+            sid = ids[-1]
+        return sid
+
+    def _overview(self, query, body):
+        sid = self._pick_session(query)
+        updates = self.storage.get_all_updates(sid) if sid else []
+        return self._json({
+            "session": sid,
+            "iterations": [u["iteration"] for u in updates],
+            "scores": [u["score"] for u in updates],
+            "durations_ms": [u.get("duration_ms") for u in updates],
+            "memory": updates[-1].get("memory", {}) if updates else {},
+        })
+
+    def _model(self, query, body):
+        sid = self._pick_session(query)
+        static = self.storage.get_static_info(sid) if sid else None
+        latest = self.storage.get_latest_update(sid) if sid else None
+        return self._json({
+            "session": sid,
+            "static": static,
+            "param_stats": (latest or {}).get("param_stats", {}),
+            "gradient_stats": (latest or {}).get("gradient_stats", {}),
+        })
+
+
+class RemoteReceiverModule(UIModule):
+    """Accepts POSTed reports from RemoteUIStatsStorageRouter (reference:
+    module/remote/RemoteReceiverModule.java)."""
+
+    def __init__(self):
+        self.storage = None
+
+    def on_attach(self, storage):
+        self.storage = storage
+
+    def routes(self):
+        return {("POST", "/remoteReceive"): self._receive}
+
+    def _receive(self, query, body):
+        d = json.loads(body)
+        if d.get("type") == "init":
+            self.storage.put_static_info(d)
+        else:
+            self.storage.put_update(d)
+        return 200, "application/json", b'{"status":"ok"}'
+
+
+class UIServer:
+    """(reference: PlayUIServer — getInstance().attach(statsStorage))"""
+
+    _instance = None
+
+    def __init__(self, port=9000, modules=None):
+        self.port = port
+        self.storage = None
+        self.modules = modules or [DefaultModule(), TrainModule(),
+                                   RemoteReceiverModule()]
+        self._routes = {}
+        for m in self.modules:
+            self._routes.update(m.routes())
+        self._httpd = None
+        self._thread = None
+
+    @classmethod
+    def get_instance(cls, port=9000):
+        if cls._instance is None:
+            cls._instance = UIServer(port)
+            cls._instance.start()
+        return cls._instance
+
+    def attach(self, stats_storage):
+        self.storage = stats_storage
+        for m in self.modules:
+            m.on_attach(stats_storage)
+        return self
+
+    def start(self):
+        if self.storage is None:
+            self.attach(InMemoryStatsStorage())
+        routes = self._routes
+
+        class Handler(BaseHTTPRequestHandler):
+            def log_message(self, *a):  # silence request logging
+                pass
+
+            def _dispatch(self, method):
+                from urllib.parse import urlparse, parse_qs
+                u = urlparse(self.path)
+                query = {k: v[0] for k, v in parse_qs(u.query).items()}
+                handler = routes.get((method, u.path))
+                if handler is None:
+                    self.send_response(404)
+                    self.end_headers()
+                    return
+                length = int(self.headers.get("Content-Length", 0))
+                body = self.rfile.read(length) if length else b""
+                status, ctype, content = handler(query, body)
+                self.send_response(status)
+                self.send_header("Content-Type", ctype)
+                self.send_header("Content-Length", str(len(content)))
+                self.end_headers()
+                self.wfile.write(content)
+
+            def do_GET(self):
+                self._dispatch("GET")
+
+            def do_POST(self):
+                self._dispatch("POST")
+
+        self._httpd = ThreadingHTTPServer(("127.0.0.1", self.port), Handler)
+        self.port = self._httpd.server_port  # resolves port=0 to the real one
+        self._thread = threading.Thread(target=self._httpd.serve_forever,
+                                        daemon=True)
+        self._thread.start()
+        return self
+
+    def stop(self):
+        if self._httpd:
+            self._httpd.shutdown()
+            self._httpd.server_close()
+        if UIServer._instance is self:
+            UIServer._instance = None
+
+    @property
+    def url(self):
+        return f"http://127.0.0.1:{self.port}"
+
+
+_INDEX_HTML = b"""<!doctype html>
+<html><head><title>deeplearning4j-tpu training UI</title>
+<style>body{font-family:sans-serif;margin:2em}canvas{border:1px solid #ccc}</style>
+</head><body>
+<h2>Training overview</h2>
+<div id="meta"></div>
+<canvas id="score" width="900" height="300"></canvas>
+<script>
+async function refresh(){
+  const r = await fetch('/train/overview'); const d = await r.json();
+  document.getElementById('meta').textContent =
+    'session: ' + d.session + '  iterations: ' + d.iterations.length;
+  const c = document.getElementById('score').getContext('2d');
+  c.clearRect(0,0,900,300);
+  const ys = d.scores; if (!ys.length) return;
+  const ymax = Math.max(...ys), ymin = Math.min(...ys);
+  c.beginPath(); c.strokeStyle = '#2060c0';
+  ys.forEach((y,i)=>{
+    const px = 20 + i*(860/Math.max(ys.length-1,1));
+    const py = 280 - 260*(y-ymin)/Math.max(ymax-ymin,1e-9);
+    i ? c.lineTo(px,py) : c.moveTo(px,py);
+  });
+  c.stroke();
+}
+refresh(); setInterval(refresh, 2000);
+</script></body></html>"""

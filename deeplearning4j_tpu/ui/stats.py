@@ -1,0 +1,193 @@
+"""Training statistics collection.
+
+Reference: deeplearning4j-ui-model stats/BaseStatsListener.java:43,273,419-436
+(samples score, param/gradient/update/activation histograms and mean
+magnitudes, JVM+off-heap memory, GC counts, hardware info, encoded with SBE)
+and stats/impl/SbeStatsReport.java.
+
+Redesign: SBE wire codecs (22 generated files) are replaced by plain
+JSON-serializable report dicts — compact enough for stats traffic and
+human-debuggable; the storage layer (ui/storage.py) persists them.
+"""
+from __future__ import annotations
+
+import gc
+import json
+import os
+import time
+
+import numpy as np
+
+
+class StatsInitReport:
+    """Static session info (reference: SbeStatsInitializationReport —
+    hardware/software/model info)."""
+
+    def __init__(self, session_id, model):
+        import jax
+        self.data = {
+            "type": "init",
+            "session_id": session_id,
+            "time": time.time(),
+            "backend": jax.default_backend(),
+            "devices": [str(d) for d in jax.devices()],
+            "n_params": int(model.num_params()) if model.params is not None else 0,
+            "model_class": type(model).__name__,
+            "pid": os.getpid(),
+        }
+
+    def to_json(self):
+        return json.dumps(self.data)
+
+
+class StatsReport:
+    """Per-iteration report (reference: SbeStatsReport)."""
+
+    def __init__(self, session_id, iteration, score, *, param_stats=None,
+                 gradient_stats=None, update_stats=None, activation_stats=None,
+                 memory=None, gc_counts=None, duration_ms=None):
+        self.data = {
+            "type": "stats",
+            "session_id": session_id,
+            "iteration": iteration,
+            "time": time.time(),
+            "score": score,
+            "param_stats": param_stats or {},
+            "gradient_stats": gradient_stats or {},
+            "update_stats": update_stats or {},
+            "activation_stats": activation_stats or {},
+            "memory": memory or {},
+            "gc_counts": gc_counts or [],
+            "duration_ms": duration_ms,
+        }
+
+    def to_json(self):
+        return json.dumps(self.data)
+
+    @staticmethod
+    def from_json(s):
+        r = StatsReport.__new__(StatsReport)
+        r.data = json.loads(s)
+        return r
+
+
+def _array_stats(arr, histogram_bins=20):
+    a = np.asarray(arr).ravel()
+    if a.size == 0:
+        return {}
+    hist, edges = np.histogram(a, bins=histogram_bins)
+    return {
+        "mean_magnitude": float(np.mean(np.abs(a))),
+        "mean": float(a.mean()),
+        "stdev": float(a.std()),
+        "min": float(a.min()),
+        "max": float(a.max()),
+        "histogram": hist.tolist(),
+        "histogram_edges": [float(edges[0]), float(edges[-1])],
+    }
+
+
+class StatsListener:
+    """(reference: BaseStatsListener.java — IterationListener feeding a
+    StatsStorageRouter). collect_* flags mirror StatsUpdateConfiguration."""
+
+    def __init__(self, storage_router, frequency=1, session_id=None,
+                 collect_params=True, collect_gradients=True,
+                 collect_activations=False, collect_memory=True,
+                 histogram_bins=20):
+        self.router = storage_router
+        self.frequency = max(1, int(frequency))
+        self.session_id = session_id or f"session_{int(time.time()*1000)}"
+        self.collect_params = collect_params
+        self.collect_gradients = collect_gradients
+        self.wants_gradients = collect_gradients  # models keep last_gradients alive
+        self.collect_activations = collect_activations
+        self.collect_memory = collect_memory
+        self.histogram_bins = histogram_bins
+        self._initialized = False
+        self._last_time = None
+
+    def on_epoch_start(self, model):
+        pass
+
+    def on_epoch_end(self, model):
+        pass
+
+    def iteration_done(self, model, iteration):
+        if not self._initialized:
+            self.router.put_static_info(StatsInitReport(self.session_id, model))
+            self._initialized = True
+        if iteration % self.frequency != 0:
+            return
+        now = time.perf_counter()
+        duration = None if self._last_time is None else \
+            (now - self._last_time) * 1000.0
+        self._last_time = now
+
+        param_stats = {}
+        if self.collect_params and model.params is not None:
+            for name, p in model.param_table().items():
+                param_stats[name] = _array_stats(p, self.histogram_bins)
+        grad_stats = {}
+        if self.collect_gradients:
+            grads = getattr(model, "last_gradients", None)
+            if grads is not None:
+                import jax
+                flat = jax.tree_util.tree_flatten_with_path(grads)[0]
+                for path, g in flat:
+                    grad_stats[jax.tree_util.keystr(path)] = \
+                        _array_stats(g, self.histogram_bins)
+        memory = {}
+        if self.collect_memory:
+            memory = self._memory_stats()
+        report = StatsReport(
+            self.session_id, iteration, float(model.score_value),
+            param_stats=param_stats, gradient_stats=grad_stats,
+            memory=memory, gc_counts=list(gc.get_count()),
+            duration_ms=duration)
+        self.router.put_update(report)
+
+    @staticmethod
+    def _memory_stats():
+        out = {}
+        try:
+            import resource
+            out["max_rss_kb"] = resource.getrusage(resource.RUSAGE_SELF).ru_maxrss
+        except Exception:
+            pass
+        try:
+            import jax
+            for d in jax.local_devices():
+                ms = d.memory_stats()
+                if ms:
+                    out[f"device_{d.id}_bytes_in_use"] = ms.get("bytes_in_use")
+                    break
+        except Exception:
+            pass
+        return out
+
+
+class ProfilerListener:
+    """XLA/TPU profiler hook (the TPU analog of the reference's absent tracer —
+    SURVEY.md §5 'no tracer'; jax.profiler traces go to TensorBoard format)."""
+
+    def __init__(self, log_dir, start_iteration=10, n_iterations=5):
+        self.log_dir = str(log_dir)
+        self.start_iteration = start_iteration
+        self.end_iteration = start_iteration + n_iterations
+        self._active = False
+
+    def on_epoch_start(self, model):
+        pass
+
+    def on_epoch_end(self, model):
+        pass
+
+    def iteration_done(self, model, iteration):
+        import jax
+        if iteration == self.start_iteration and not self._active:
+            jax.profiler.start_trace(self.log_dir)
+            self._active = True
+        elif iteration >= self.end_iteration and self._active:
+            jax.profiler.stop_trace()
+            self._active = False

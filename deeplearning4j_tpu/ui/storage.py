@@ -1,0 +1,163 @@
+"""Stats storage + routing.
+
+Reference: deeplearning4j-core api/storage/{StatsStorage.java,
+StatsStorageRouter.java, Persistable.java} and impl/
+{CollectionStatsStorageRouter, RemoteUIStatsStorageRouter.java (HTTP POST)};
+deeplearning4j-ui-model storage/{InMemoryStatsStorage, FileStatsStorage,
+mapdb/MapDBStatsStorage, sqlite/J7FileStatsStorage}.
+
+The reports are JSON (ui/stats.py) so FileStatsStorage is a JSONL append log
+(replacing MapDB/SQLite — same durability role, zero dependencies).
+"""
+from __future__ import annotations
+
+import json
+import os
+import threading
+
+
+class StatsStorageRouter:
+    """Write-side API (reference: api/storage/StatsStorageRouter.java)."""
+
+    def put_static_info(self, report):
+        raise NotImplementedError
+
+    def put_update(self, report):
+        raise NotImplementedError
+
+
+class CollectionStatsStorageRouter(StatsStorageRouter):
+    """Collects into plain lists (reference:
+    impl/CollectionStatsStorageRouter.java)."""
+
+    def __init__(self):
+        self.static_info = []
+        self.updates = []
+
+    def put_static_info(self, report):
+        self.static_info.append(report)
+
+    def put_update(self, report):
+        self.updates.append(report)
+
+
+class InMemoryStatsStorage(StatsStorageRouter):
+    """Read+write storage (reference: InMemoryStatsStorage.java). Also the
+    subscription hub the UI server attaches to (StatsStorage listeners)."""
+
+    def __init__(self):
+        self._static = {}     # session_id -> report dict
+        self._updates = {}    # session_id -> [report dict]
+        self._listeners = []
+        self._lock = threading.Lock()
+
+    # ---- router (write) ---------------------------------------------------
+    def put_static_info(self, report):
+        d = report.data if hasattr(report, "data") else dict(report)
+        with self._lock:
+            self._static[d["session_id"]] = d
+        self._notify(d)
+
+    def put_update(self, report):
+        d = report.data if hasattr(report, "data") else dict(report)
+        with self._lock:
+            self._updates.setdefault(d["session_id"], []).append(d)
+        self._notify(d)
+
+    # ---- storage (read) ---------------------------------------------------
+    def list_session_ids(self):
+        with self._lock:
+            ids = set(self._static) | set(self._updates)
+        return sorted(ids)
+
+    def get_static_info(self, session_id):
+        with self._lock:
+            return self._static.get(session_id)
+
+    def get_all_updates(self, session_id):
+        with self._lock:
+            return list(self._updates.get(session_id, []))
+
+    def get_latest_update(self, session_id):
+        with self._lock:
+            ups = self._updates.get(session_id)
+            return ups[-1] if ups else None
+
+    # ---- listeners --------------------------------------------------------
+    def register_listener(self, fn):
+        self._listeners.append(fn)
+
+    def _notify(self, d):
+        for fn in self._listeners:
+            fn(d)
+
+
+class FileStatsStorage(InMemoryStatsStorage):
+    """Durable JSONL-backed storage (reference: FileStatsStorage.java /
+    MapDBStatsStorage role). Appends every report; reloads on open."""
+
+    def __init__(self, path):
+        super().__init__()
+        self.path = str(path)
+        if os.path.exists(self.path):
+            with open(self.path) as fh:
+                for line in fh:
+                    line = line.strip()
+                    if not line:
+                        continue
+                    d = json.loads(line)
+                    if d.get("type") == "init":
+                        super().put_static_info(d)
+                    else:
+                        super().put_update(d)
+        self._fh = open(self.path, "a")
+
+    def put_static_info(self, report):
+        d = report.data if hasattr(report, "data") else dict(report)
+        self._fh.write(json.dumps(d) + "\n")
+        self._fh.flush()
+        super().put_static_info(d)
+
+    def put_update(self, report):
+        d = report.data if hasattr(report, "data") else dict(report)
+        self._fh.write(json.dumps(d) + "\n")
+        self._fh.flush()
+        super().put_update(d)
+
+    def close(self):
+        self._fh.close()
+
+
+class RemoteUIStatsStorageRouter(StatsStorageRouter):
+    """HTTP POST of reports to a remote UI server (reference:
+    impl/RemoteUIStatsStorageRouter.java; receiver = the UI server's
+    RemoteReceiverModule). Retries with backoff like the reference
+    (maxRetryCount/retryBackoffBase)."""
+
+    def __init__(self, url, max_retries=3, backoff_base_ms=100):
+        self.url = url.rstrip("/") + "/remoteReceive"
+        self.max_retries = max_retries
+        self.backoff_base_ms = backoff_base_ms
+
+    def _post(self, d):
+        import time
+        import urllib.request
+        body = json.dumps(d).encode()
+        for attempt in range(self.max_retries + 1):
+            try:
+                req = urllib.request.Request(
+                    self.url, data=body,
+                    headers={"Content-Type": "application/json"})
+                with urllib.request.urlopen(req, timeout=5) as resp:
+                    resp.read()
+                return True
+            except Exception:
+                if attempt == self.max_retries:
+                    return False
+                time.sleep(self.backoff_base_ms / 1000.0 * (2 ** attempt))
+
+    def put_static_info(self, report):
+        self._post(report.data if hasattr(report, "data") else dict(report))
+
+    def put_update(self, report):
+        self._post(report.data if hasattr(report, "data") else dict(report))

@@ -79,8 +79,11 @@ class AsyncIterator:
         return self
 
     def __next__(self):
+        if getattr(self, "_done", False):  # keep raising after exhaustion
+            raise StopIteration
         item = self._queue.get()
         if item is self._SENTINEL:
+            self._done = True
             if self._error is not None:
                 raise self._error
             raise StopIteration

@@ -97,6 +97,22 @@ def test_sptree_mass_and_forces():
     np.testing.assert_allclose(neg, neg_exact, rtol=1e-6)
 
 
+def test_kdtree_equidistant_duplicates():
+    tree = KDTree(points=[[0, 0], [1, 1], [1, 1], [2, 2]])
+    res = tree.knn([0.9, 0.9], 3)  # duplicate points must not crash the sort
+    assert len(res) == 3
+    assert res[0][0] <= res[1][0] <= res[2][0]
+
+
+def test_vptree_duplicate_heavy_no_recursion_blowup():
+    pts = np.zeros((1500, 3))
+    pts[:5] = np.arange(15).reshape(5, 3)
+    tree = VPTree(pts, seed=1)
+    idxs, dists = tree.search(np.zeros(3), 4)
+    assert len(idxs) == 4
+    assert dists[0] == 0.0
+
+
 # ------------------------------------------------------------------ t-SNE
 
 def test_tsne_exact_separates_blobs():
@@ -183,8 +199,11 @@ def test_magic_queue_round_robin():
 
 
 def test_async_iterator():
-    out = list(AsyncIterator(iter(range(100)), buffer_size=4))
+    it = AsyncIterator(iter(range(100)), buffer_size=4)
+    out = list(it)
     assert out == list(range(100))
+    with pytest.raises(StopIteration):  # must not hang after exhaustion
+        next(it)
 
 
 def test_async_iterator_propagates_errors():

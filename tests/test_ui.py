@@ -1,0 +1,114 @@
+"""Observability stack tests — mirroring the reference's ui test suites
+(TestStatsListener, TestStatsStorage, ui server tests)."""
+import json
+import urllib.request
+
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu import (NeuralNetConfiguration, InputType, DenseLayer,
+                                OutputLayer, MultiLayerNetwork, Sgd, DataSet)
+from deeplearning4j_tpu.ui import (StatsListener, InMemoryStatsStorage,
+                                   FileStatsStorage, RemoteUIStatsStorageRouter,
+                                   CollectionStatsStorageRouter, UIServer,
+                                   components)
+
+
+def _net_and_data(seed=0):
+    rng = np.random.default_rng(seed)
+    x = rng.normal(size=(32, 4))
+    y = np.eye(2)[(x.sum(1) > 0).astype(int)]
+    conf = (NeuralNetConfiguration.builder().seed(seed).updater(Sgd(0.1)).list()
+            .layer(DenseLayer(n_out=8, activation="tanh"))
+            .layer(OutputLayer(n_out=2, activation="softmax", loss="MCXENT"))
+            .set_input_type(InputType.feed_forward(4))
+            .build())
+    return MultiLayerNetwork(conf).init(), DataSet(x, y)
+
+
+def test_stats_listener_collects_reports():
+    net, ds = _net_and_data()
+    storage = InMemoryStatsStorage()
+    net.set_listeners(StatsListener(storage, frequency=1, session_id="s1"))
+    for _ in range(5):
+        net.fit_batch(ds)
+    assert storage.list_session_ids() == ["s1"]
+    init = storage.get_static_info("s1")
+    assert init["n_params"] == net.num_params()
+    ups = storage.get_all_updates("s1")
+    assert len(ups) == 5
+    last = ups[-1]
+    assert np.isfinite(last["score"])
+    # param stats present with histograms
+    key = next(iter(last["param_stats"]))
+    st = last["param_stats"][key]
+    assert "mean_magnitude" in st and len(st["histogram"]) == 20
+    # gradient stats captured from the train step
+    assert last["gradient_stats"], "expected gradient stats"
+    gkey = next(iter(last["gradient_stats"]))
+    assert last["gradient_stats"][gkey]["mean_magnitude"] >= 0
+
+
+def test_file_stats_storage_roundtrip(tmp_path):
+    net, ds = _net_and_data(1)
+    p = tmp_path / "stats.jsonl"
+    storage = FileStatsStorage(p)
+    net.set_listeners(StatsListener(storage, session_id="s2"))
+    for _ in range(3):
+        net.fit_batch(ds)
+    storage.close()
+    # reload from disk
+    storage2 = FileStatsStorage(p)
+    assert storage2.list_session_ids() == ["s2"]
+    assert len(storage2.get_all_updates("s2")) == 3
+    assert storage2.get_static_info("s2")["model_class"] == "MultiLayerNetwork"
+
+
+def test_ui_server_endpoints_and_remote_router():
+    server = UIServer(port=0).attach(InMemoryStatsStorage()).start()
+    try:
+        # remote router -> POST /remoteReceive -> storage
+        router = RemoteUIStatsStorageRouter(server.url)
+        net, ds = _net_and_data(2)
+        net.set_listeners(StatsListener(router, session_id="remote1"))
+        for _ in range(4):
+            net.fit_batch(ds)
+        with urllib.request.urlopen(server.url + "/train/sessions") as r:
+            sessions = json.loads(r.read())
+        assert "remote1" in sessions
+        with urllib.request.urlopen(server.url + "/train/overview?sid=remote1") as r:
+            ov = json.loads(r.read())
+        assert len(ov["scores"]) == 4
+        assert ov["iterations"] == [1, 2, 3, 4]
+        with urllib.request.urlopen(server.url + "/train/model?sid=remote1") as r:
+            model = json.loads(r.read())
+        assert model["static"]["n_params"] == net.num_params()
+        with urllib.request.urlopen(server.url + "/") as r:
+            html = r.read()
+        assert b"Training overview" in html
+    finally:
+        server.stop()
+
+
+def test_collection_router():
+    net, ds = _net_and_data(3)
+    router = CollectionStatsStorageRouter()
+    net.set_listeners(StatsListener(router, frequency=2, session_id="c1"))
+    for _ in range(4):
+        net.fit_batch(ds)
+    assert len(router.static_info) == 1
+    assert len(router.updates) == 2  # frequency=2
+
+
+def test_components_serde():
+    chart = (components.ChartLine(title="score")
+             .add_series("train", [0, 1, 2], [1.0, 0.5, 0.2]))
+    table = components.ComponentTable(header=["k", "v"],
+                                      content=[["lr", "0.1"]], title="config")
+    div = components.ComponentDiv(chart, table,
+                                  components.ComponentText("hello"))
+    d = div.to_dict()
+    rebuilt = components.component_from_dict(json.loads(json.dumps(d)))
+    assert rebuilt.to_dict() == d
+    hist = components.ChartHistogram(title="h").add_bin(0, 1, 5).add_bin(1, 2, 3)
+    assert hist.to_dict()["bins"][1] == {"lower": 1.0, "upper": 2.0, "y": 3.0}
