@@ -1,0 +1,7 @@
+"""Model import/interop (reference: deeplearning4j-modelimport — Keras 1.x
+HDF5/JSON import, SURVEY.md §2.7). The native HDF5 dependency is replaced by
+the pure-Python hdf5_lite reader/writer."""
+from .keras import KerasModelImport, export_keras_sequential
+from . import hdf5_lite
+
+__all__ = ["KerasModelImport", "export_keras_sequential", "hdf5_lite"]

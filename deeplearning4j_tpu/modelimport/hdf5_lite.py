@@ -1,0 +1,431 @@
+"""Minimal pure-Python HDF5 reader/writer.
+
+Reference: the reference reads Keras h5 files through the native HDF5 C
+library via JavaCPP (modelimport Hdf5Archive.java:22-35, SURVEY.md §2.9 #5).
+This environment ships no h5py, so this module implements the HDF5 v1 file
+format subset that Keras 1.x files use:
+
+- superblock v0, v1 object headers (+ continuation blocks)
+- old-style groups: symbol-table message -> v1 B-tree -> SNOD + local heap
+- contiguous-layout datasets of fixed-point/floating-point/fixed-string types
+- attribute messages with scalar/1-D dataspaces of numeric or fixed-length
+  string types (what Keras writes: model_config JSON, layer_names,
+  weight_names, keras_version)
+
+The writer emits the same subset (spec-compliant, h5py-readable) and exists
+mainly to build test fixtures and to export models in Keras-compatible form.
+Unsupported features (chunked+filtered data, v2 headers, variable-length
+strings) raise clear errors.
+"""
+from __future__ import annotations
+
+import struct
+
+import numpy as np
+
+UNDEF = 0xFFFFFFFFFFFFFFFF
+SIG = b"\x89HDF\r\n\x1a\n"
+
+
+def _pad8(n):
+    return (8 - n % 8) % 8
+
+
+# =====================================================================
+# writer
+# =====================================================================
+
+class _DatatypeSpec:
+    """(message_body, numpy dtype) pairs for the supported types."""
+
+    @staticmethod
+    def for_array(arr):
+        dt = arr.dtype
+        if dt.kind == "f":
+            if dt.itemsize == 4:
+                return _DatatypeSpec.f32()
+            return _DatatypeSpec.f64()
+        if dt.kind in ("i", "u"):
+            signed = dt.kind == "i"
+            return _DatatypeSpec.fixed(dt.itemsize, signed)
+        if dt.kind == "S":
+            return _DatatypeSpec.string(dt.itemsize)
+        raise ValueError(f"unsupported dtype {dt}")
+
+    @staticmethod
+    def f32():
+        body = bytes([0x11, 0x20, 0x1F, 0x00]) + struct.pack("<I", 4)
+        body += struct.pack("<HHBBBBI", 0, 32, 23, 8, 0, 23, 127)
+        return body, np.dtype("<f4")
+
+    @staticmethod
+    def f64():
+        body = bytes([0x11, 0x20, 0x3F, 0x00]) + struct.pack("<I", 8)
+        body += struct.pack("<HHBBBBI", 0, 64, 52, 11, 0, 52, 1023)
+        return body, np.dtype("<f8")
+
+    @staticmethod
+    def fixed(size, signed=True):
+        bits = 0x08 if signed else 0x00  # bit3 = signed
+        body = bytes([0x10, bits, 0x00, 0x00]) + struct.pack("<I", size)
+        body += struct.pack("<HH", 0, size * 8)
+        return body, np.dtype(f"<i{size}" if signed else f"<u{size}")
+
+    @staticmethod
+    def string(size):
+        # class 3 fixed string, null-padded, ASCII
+        body = bytes([0x13, 0x00, 0x00, 0x00]) + struct.pack("<I", size)
+        return body, np.dtype(f"S{size}")
+
+
+def _dataspace_body(shape):
+    if shape == ():
+        return struct.pack("<BBBxxxxx", 1, 0, 0)
+    body = struct.pack("<BBBxxxxx", 1, len(shape), 0)
+    for d in shape:
+        body += struct.pack("<Q", d)
+    return body
+
+
+def _message(mtype, body):
+    body = body + b"\x00" * _pad8(len(body))
+    return struct.pack("<HHBxxx", mtype, len(body), 0) + body
+
+
+def _attribute_message(name, value):
+    value = np.asarray(value)
+    dt_body, dt = _DatatypeSpec.for_array(value)
+    value = value.astype(dt)
+    shape = () if value.ndim == 0 else value.shape
+    ds_body = _dataspace_body(shape)
+    name_b = name.encode() + b"\x00"
+    body = struct.pack("<BxHHH", 1, len(name_b), len(dt_body), len(ds_body))
+    body += name_b + b"\x00" * _pad8(len(name_b))
+    body += dt_body + b"\x00" * _pad8(len(dt_body))
+    body += ds_body + b"\x00" * _pad8(len(ds_body))
+    body += value.tobytes()
+    return _message(0x000C, body)
+
+
+class _Writer:
+    def __init__(self):
+        self.buf = bytearray()
+
+    def tell(self):
+        return len(self.buf)
+
+    def write(self, b):
+        off = len(self.buf)
+        self.buf.extend(b)
+        return off
+
+    def patch(self, off, b):
+        self.buf[off:off + len(b)] = b
+
+
+class H5Group:
+    """In-memory group for H5File writing."""
+
+    def __init__(self):
+        self.attrs = {}
+        self.groups = {}     # name -> H5Group
+        self.datasets = {}   # name -> np.ndarray
+
+    def create_group(self, name):
+        g = H5Group()
+        self.groups[name] = g
+        return g
+
+    def create_dataset(self, name, data):
+        self.datasets[name] = np.asarray(data)
+
+
+class H5File(H5Group):
+    """Minimal h5py.File-alike; write() serializes, H5Reader reads."""
+
+    def save(self, path):
+        w = _Writer()
+        # superblock placeholder: 24B header + addresses + 40B root entry
+        w.write(b"\x00" * (24 + 32 + 40))
+        root_hdr = _write_group(w, self)
+        eof = w.tell()
+        sb = SIG + bytes([0, 0, 0, 0, 0, 8, 8, 0])
+        sb += struct.pack("<HH", 4, 16) + struct.pack("<I", 0)
+        sb += struct.pack("<QQQQ", 0, UNDEF, eof, UNDEF)
+        sb += struct.pack("<QQ", 0, root_hdr) + struct.pack("<I", 0) + b"\x00" * 20
+        w.patch(0, sb)
+        with open(path, "wb") as fh:
+            fh.write(bytes(w.buf))
+
+
+def _write_object_header(w, messages):
+    total = sum(len(m) for m in messages)
+    hdr = struct.pack("<BxHIIxxxx", 1, len(messages), 1, total)
+    return w.write(hdr + b"".join(messages))
+
+
+def _write_dataset(w, arr):
+    arr = np.asarray(arr)
+    dt_body, dt = _DatatypeSpec.for_array(arr)
+    arr = arr.astype(dt)
+    data_addr = w.write(arr.tobytes())
+    msgs = [
+        _message(0x0001, _dataspace_body(arr.shape if arr.ndim else ())),
+        _message(0x0003, dt_body),
+        # layout v3 class 1 (contiguous)
+        _message(0x0008, struct.pack("<BBQQ", 3, 1, data_addr, arr.nbytes)),
+    ]
+    return msgs
+
+
+def _write_group(w, group):
+    """Writes heap/SNOD/btree + object header; returns header address."""
+    entries = []   # (name, header_addr)
+    for name, sub in group.groups.items():
+        entries.append((name, _write_group(w, sub)))
+    for name, arr in group.datasets.items():
+        msgs = _write_dataset(w, arr)
+        msgs += [_attribute_message(k, v) for k, v in
+                 getattr(arr, "h5_attrs", {}).items()]
+        entries.append((name, _write_object_header(w, msgs)))
+    entries.sort(key=lambda e: e[0])
+
+    msgs = [_attribute_message(k, v) for k, v in group.attrs.items()]
+    if entries or not msgs:
+        # local heap data: offset 0 must be the empty string
+        heap_data = bytearray(b"\x00" * 8)
+        offsets = []
+        for name, _ in entries:
+            offsets.append(len(heap_data))
+            nb = name.encode() + b"\x00"
+            heap_data.extend(nb + b"\x00" * _pad8(len(nb)))
+        heap_seg_addr = w.write(bytes(heap_data))
+        heap_addr = w.write(b"HEAP" + struct.pack("<Bxxx", 0) +
+                            struct.pack("<QQQ", len(heap_data), UNDEF,
+                                        heap_seg_addr))
+        snod = b"SNOD" + struct.pack("<BxH", 1, len(entries))
+        for (name, hdr_addr), off in zip(entries, offsets):
+            snod += struct.pack("<QQI4x16x", off, hdr_addr, 0)
+        snod_addr = w.write(snod)
+        k_leaf = 4
+        btree = b"TREE" + struct.pack("<BBH", 0, 0, 1)
+        btree += struct.pack("<QQ", UNDEF, UNDEF)
+        btree += struct.pack("<Q", 0)          # key 0: lowest name offset
+        btree += struct.pack("<Q", snod_addr)  # child 0
+        btree += struct.pack("<Q", offsets[-1] if offsets else 0)  # key 1
+        btree += b"\x00" * (2 * k_leaf - 1) * 16  # unused key/child slots
+        btree_addr = w.write(btree)
+        msgs.insert(0, _message(0x0011, struct.pack("<QQ", btree_addr, heap_addr)))
+    return _write_object_header(w, msgs)
+
+
+# =====================================================================
+# reader
+# =====================================================================
+
+class H5Object:
+    """A parsed group or dataset."""
+
+    def __init__(self, reader, addr):
+        self._r = reader
+        self.addr = addr
+        self.attrs = {}
+        self._links = {}        # name -> addr (groups)
+        self._shape = None
+        self._dtype = None
+        self._data_addr = None
+        self._data_size = None
+        reader._parse_object(self)
+
+    # ---- group-like -------------------------------------------------------
+    def keys(self):
+        return list(self._links)
+
+    def __contains__(self, name):
+        return name in self._links
+
+    def __getitem__(self, name):
+        if "/" in name:
+            head, rest = name.split("/", 1)
+            obj = self[head] if head else self
+            return obj[rest]
+        if name not in self._links:
+            raise KeyError(name)
+        return H5Object(self._r, self._links[name])
+
+    # ---- dataset-like -----------------------------------------------------
+    @property
+    def is_dataset(self):
+        return self._data_addr is not None
+
+    def __array__(self):
+        return self.value
+
+    @property
+    def value(self):
+        if not self.is_dataset:
+            raise ValueError("not a dataset")
+        raw = self._r.data[self._data_addr:self._data_addr + self._data_size]
+        arr = np.frombuffer(raw, dtype=self._dtype)
+        return arr.reshape(self._shape)
+
+
+class H5Reader:
+    def __init__(self, path_or_bytes):
+        if isinstance(path_or_bytes, (bytes, bytearray)):
+            self.data = bytes(path_or_bytes)
+        else:
+            with open(path_or_bytes, "rb") as fh:
+                self.data = fh.read()
+        if self.data[:8] != SIG:
+            raise ValueError("not an HDF5 file")
+        ver = self.data[8]
+        if ver != 0:
+            raise NotImplementedError(f"superblock version {ver} unsupported")
+        # fixed-size v0 superblock: root symbol-table entry at offset 24+32
+        root_entry = 24 + 32
+        self.root_addr = struct.unpack_from("<Q", self.data, root_entry + 8)[0]
+        self.root = H5Object(self, self.root_addr)
+
+    # ---- object header parsing -------------------------------------------
+    def _parse_object(self, obj):
+        d = self.data
+        addr = obj.addr
+        version, = struct.unpack_from("<B", d, addr)
+        if version != 1:
+            raise NotImplementedError(f"object header v{version} unsupported")
+        n_msgs, = struct.unpack_from("<H", d, addr + 2)
+        hdr_size, = struct.unpack_from("<I", d, addr + 8)
+        blocks = [(addr + 16, hdr_size)]
+        parsed = 0
+        while blocks and parsed < n_msgs:
+            pos, remaining = blocks.pop(0)
+            while remaining >= 8 and parsed < n_msgs:
+                mtype, msize, _flags = struct.unpack_from("<HHB", d, pos)
+                body = pos + 8
+                self._handle_message(obj, mtype, body, msize, blocks)
+                pos += 8 + msize
+                remaining -= 8 + msize
+                parsed += 1
+
+    def _handle_message(self, obj, mtype, pos, size, blocks):
+        d = self.data
+        if mtype == 0x0010:    # continuation
+            off, length = struct.unpack_from("<QQ", d, pos)
+            blocks.append((off, length))
+        elif mtype == 0x0011:  # symbol table (group)
+            btree, heap = struct.unpack_from("<QQ", d, pos)
+            self._walk_btree(obj, btree, heap)
+        elif mtype == 0x0001:  # dataspace
+            obj._shape = self._parse_dataspace(pos)
+        elif mtype == 0x0003:  # datatype
+            obj._dtype = self._parse_datatype(pos)
+        elif mtype == 0x0008:  # layout
+            version = d[pos]
+            if version == 3:
+                cls = d[pos + 1]
+                if cls == 1:
+                    obj._data_addr, obj._data_size = \
+                        struct.unpack_from("<QQ", d, pos + 2)
+                elif cls == 0:  # compact
+                    sz, = struct.unpack_from("<H", d, pos + 2)
+                    obj._data_addr, obj._data_size = pos + 4, sz
+                else:
+                    raise NotImplementedError("chunked datasets unsupported")
+            else:
+                raise NotImplementedError(f"layout v{version} unsupported")
+        elif mtype == 0x000C:  # attribute
+            self._parse_attribute(obj, pos)
+
+    def _parse_dataspace(self, pos):
+        d = self.data
+        version, ndim, flags = struct.unpack_from("<BBB", d, pos)
+        if version == 1:
+            off = pos + 8
+        elif version == 2:
+            off = pos + 4
+        else:
+            raise NotImplementedError(f"dataspace v{version}")
+        dims = struct.unpack_from(f"<{ndim}Q", d, off) if ndim else ()
+        return tuple(dims)
+
+    def _parse_datatype(self, pos):
+        d = self.data
+        cv = d[pos]
+        cls = cv & 0x0F
+        bits = d[pos + 1:pos + 4]
+        size, = struct.unpack_from("<I", d, pos + 4)
+        if cls == 0:   # fixed point
+            signed = bool(bits[0] & 0x08)
+            be = bool(bits[0] & 0x01)
+            ch = ">" if be else "<"
+            return np.dtype(f"{ch}i{size}" if signed else f"{ch}u{size}")
+        if cls == 1:   # float
+            be = bool(bits[0] & 0x01)
+            return np.dtype(f"{'>' if be else '<'}f{size}")
+        if cls == 3:   # string
+            return np.dtype(f"S{size}")
+        if cls == 9:
+            raise NotImplementedError(
+                "variable-length types unsupported (use fixed-size strings)")
+        raise NotImplementedError(f"datatype class {cls}")
+
+    def _parse_attribute(self, obj, pos):
+        d = self.data
+        version = d[pos]
+        if version != 1:
+            raise NotImplementedError(f"attribute v{version}")
+        name_size, dt_size, ds_size = struct.unpack_from("<HHH", d, pos + 2)
+        p = pos + 8
+        name = d[p:p + name_size].split(b"\x00")[0].decode()
+        p += name_size + _pad8(name_size)
+        dtype = self._parse_datatype(p)
+        p += dt_size + _pad8(dt_size)
+        shape = self._parse_dataspace(p)
+        p += ds_size + _pad8(ds_size)
+        count = int(np.prod(shape)) if shape else 1
+        arr = np.frombuffer(d, dtype=dtype, count=count, offset=p)
+        arr = arr.reshape(shape)
+        if dtype.kind == "S":
+            vals = [v.split(b"\x00")[0].decode("utf-8", "replace")
+                    for v in arr.ravel()]
+            obj.attrs[name] = vals[0] if shape == () else vals
+        else:
+            obj.attrs[name] = arr[()] if shape == () else arr
+
+    # ---- group walking ----------------------------------------------------
+    def _walk_btree(self, obj, btree_addr, heap_addr):
+        d = self.data
+        heap_seg, = struct.unpack_from("<Q", d, heap_addr + 24)
+
+        def name_at(off):
+            end = d.index(b"\x00", heap_seg + off)
+            return d[heap_seg + off:end].decode()
+
+        def walk(addr):
+            assert d[addr:addr + 4] == b"TREE", "bad btree node"
+            level = d[addr + 5]
+            n, = struct.unpack_from("<H", d, addr + 6)
+            children = struct.unpack_from(f"<{2*n+1}Q", d, addr + 24)[1::2]
+            for child in children:
+                if level > 0:
+                    walk(child)
+                else:
+                    self._read_snod(obj, child, name_at)
+
+        walk(btree_addr)
+
+    def _read_snod(self, obj, addr, name_at):
+        d = self.data
+        assert d[addr:addr + 4] == b"SNOD", "bad symbol node"
+        n, = struct.unpack_from("<H", d, addr + 6)
+        p = addr + 8
+        for _ in range(n):
+            name_off, hdr_addr = struct.unpack_from("<QQ", d, p)
+            obj._links[name_at(name_off)] = hdr_addr
+            p += 40
+
+
+def load(path_or_bytes):
+    """Open for reading; returns the root H5Object."""
+    return H5Reader(path_or_bytes).root

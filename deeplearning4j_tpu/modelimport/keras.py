@@ -1,0 +1,412 @@
+"""Keras 1.x model import.
+
+Reference: deeplearning4j-modelimport — KerasModelImport.java:48-299 (entry
+overloads), KerasModel.java (config parse :358, weight copy :583-598),
+KerasSequentialModel.java:138,208-211, KerasLayer.java and the 11 layer
+mappers layers/Keras{Dense,Convolution,Pooling,Lstm,Embedding,
+BatchNormalization,Merge,Flatten,Dropout,Activation,Input,Loss}.java,
+preprocessors/TensorFlowCnnToFeedForwardPreProcessor.java (dim-ordering).
+
+HDF5 access goes through the pure-Python hdf5_lite reader (the reference
+uses the native HDF5 C library, Hdf5Archive.java:22-35).
+"""
+from __future__ import annotations
+
+import json
+
+import numpy as np
+import jax.numpy as jnp
+
+from . import hdf5_lite
+
+
+_KERAS_ACTIVATIONS = {
+    "relu": "relu", "tanh": "tanh", "sigmoid": "sigmoid",
+    "softmax": "softmax", "linear": "identity", "softplus": "softplus",
+    "softsign": "softsign", "hard_sigmoid": "hardsigmoid", "elu": "elu",
+}
+
+_KERAS_LOSSES = {
+    "categorical_crossentropy": "MCXENT", "binary_crossentropy": "XENT",
+    "mean_squared_error": "MSE", "mse": "MSE",
+    "mean_absolute_error": "L1", "mae": "L1",
+}
+
+
+def _act(name):
+    if name is None:
+        return "identity"
+    if name not in _KERAS_ACTIVATIONS:
+        raise ValueError(f"unsupported Keras activation '{name}'")
+    return _KERAS_ACTIVATIONS[name]
+
+
+class KerasLayer:
+    """One parsed Keras layer config (reference: KerasLayer.java)."""
+
+    def __init__(self, class_name, config):
+        self.class_name = class_name
+        self.config = config
+        self.name = config.get("name", class_name)
+
+
+def _map_layers(keras_layers, enforce_training_config=False, loss=None):
+    """Keras layer list -> (our layer conf list, input_type). Mirrors the
+    per-type mappers in modelimport layers/Keras*.java."""
+    from ..nn.conf import layers as L
+    from ..nn.conf.inputs import InputType
+
+    out = []
+    input_type = None
+    pending_activation = None
+
+    def batch_input_shape(cfg):
+        s = cfg.get("batch_input_shape")
+        return None if s is None else [d for d in s[1:]]
+
+    for i, kl in enumerate(keras_layers):
+        cfg = kl.config
+        cn = kl.class_name
+        if i == 0 or input_type is None:
+            shape = batch_input_shape(cfg)
+            if shape is not None:
+                if len(shape) == 1:
+                    input_type = InputType.feed_forward(shape[0])
+                elif len(shape) == 2:
+                    input_type = InputType.recurrent(shape[1])
+                elif len(shape) == 3:
+                    dim_ordering = cfg.get("dim_ordering", "tf")
+                    if dim_ordering == "th":
+                        c, h, w = shape
+                    else:
+                        h, w, c = shape
+                    input_type = InputType.convolutional(h, w, c)
+        if cn == "InputLayer":
+            continue
+        if cn == "Dense":
+            out.append(L.DenseLayer(n_out=cfg["output_dim"],
+                                    activation=_act(cfg.get("activation"))))
+        elif cn == "Convolution2D":
+            border = cfg.get("border_mode", "valid")
+            out.append(L.ConvolutionLayer(
+                n_out=cfg["nb_filter"],
+                kernel_size=(cfg["nb_row"], cfg["nb_col"]),
+                stride=tuple(cfg.get("subsample", (1, 1))),
+                convolution_mode="same" if border == "same" else "truncate",
+                activation=_act(cfg.get("activation"))))
+        elif cn in ("MaxPooling2D", "AveragePooling2D"):
+            border = cfg.get("border_mode", "valid")
+            pool = tuple(cfg.get("pool_size", (2, 2)))
+            out.append(L.SubsamplingLayer(
+                pooling_type="max" if cn == "MaxPooling2D" else "avg",
+                kernel_size=pool,
+                stride=tuple(cfg.get("strides") or pool),
+                convolution_mode="same" if border == "same" else "truncate"))
+        elif cn == "LSTM":
+            out.append(L.LSTM(n_out=cfg["output_dim"],
+                              activation=_act(cfg.get("activation")),
+                              gate_activation=_act(cfg.get("inner_activation",
+                                                           "hard_sigmoid")),
+                              forget_gate_bias_init=0.0))
+        elif cn == "Embedding":
+            out.append(L.EmbeddingLayer(n_in=cfg["input_dim"],
+                                        n_out=cfg["output_dim"],
+                                        activation="identity", has_bias=False))
+            input_type = input_type or InputType.feed_forward(cfg["input_dim"])
+        elif cn == "BatchNormalization":
+            out.append(L.BatchNormalization(eps=cfg.get("epsilon", 1e-5),
+                                            decay=cfg.get("momentum", 0.9)))
+        elif cn == "Activation":
+            out.append(L.ActivationLayer(activation=_act(cfg.get("activation"))))
+        elif cn == "Dropout":
+            out.append(L.DropoutLayer(dropout=cfg.get("p", 0.5)))
+        elif cn == "Flatten":
+            continue  # shape change handled by automatic preprocessors
+        elif cn == "ZeroPadding2D":
+            pad = cfg.get("padding", (1, 1))
+            out.append(L.ZeroPaddingLayer(padding=(pad[0], pad[0], pad[1], pad[1])
+                                          if len(pad) == 2 else tuple(pad)))
+        else:
+            raise ValueError(f"unsupported Keras layer type '{cn}' "
+                             f"(reference parity: modelimport KerasLayer.java)")
+
+    # convert the final Dense into an OutputLayer when a loss is known
+    if loss is not None and out and isinstance(out[-1], L.DenseLayer):
+        last = out[-1]
+        out[-1] = L.OutputLayer(n_out=last.n_out, activation=last.activation,
+                                loss=_KERAS_LOSSES.get(loss, loss))
+    return out, input_type
+
+
+def _assign_layer_weights(p, st, kl, weights):
+    """Weight-assignment switch per Keras layer type (dim-order + gate-order
+    fixups; reference: KerasModel.helperCopyWeightsToModel :583-598)."""
+    cn = kl.class_name
+    name = kl.name
+
+    def w(suffix):
+        for key in (f"{name}_{suffix}", suffix):
+            if key in weights:
+                return weights[key]
+        raise KeyError(f"{name}: missing weight {suffix} in {list(weights)}")
+
+    if cn == "Dense":
+        p["W"] = jnp.asarray(w("W"))
+        p["b"] = jnp.asarray(w("b"))
+    elif cn == "Convolution2D":
+        W = w("W")
+        if kl.config.get("dim_ordering", "tf") == "th":
+            W = W.transpose(2, 3, 1, 0)   # (out,in,kh,kw) -> HWIO
+        p["W"] = jnp.asarray(W)
+        p["b"] = jnp.asarray(w("b"))
+    elif cn == "Embedding":
+        p["W"] = jnp.asarray(w("W"))
+    elif cn == "BatchNormalization":
+        p["gamma"] = jnp.asarray(w("gamma"))
+        p["beta"] = jnp.asarray(w("beta"))
+        st["mean"] = jnp.asarray(w("running_mean"))
+        # keras 1.x names it running_std but keras>=1.0 stores variance
+        st["var"] = jnp.asarray(w("running_std"))
+    elif cn == "LSTM":
+        # keras gate order i, f, c(candidate), o as separate mats; ours is
+        # one fused [i|f|o|g] (recurrent.py I,F,O,G)
+        W = np.concatenate([w("W_i"), w("W_f"), w("W_o"), w("W_c")], axis=1)
+        U = np.concatenate([w("U_i"), w("U_f"), w("U_o"), w("U_c")], axis=1)
+        b = np.concatenate([w("b_i"), w("b_f"), w("b_o"), w("b_c")])
+        p["W"] = jnp.asarray(W)
+        p["RW"] = jnp.asarray(U)
+        p["b"] = jnp.asarray(b)
+
+
+_NO_WEIGHT_LAYERS = ("Dropout", "Activation", "MaxPooling2D",
+                     "AveragePooling2D", "ZeroPadding2D")
+
+
+def _copy_weights(net, weights_root, layer_names, keras_layers):
+    """Sequential-model weight copy (our layers indexed positionally)."""
+    our_idx = 0
+    for kname in layer_names:
+        kl = next((l for l in keras_layers if l.name == kname), None)
+        if kl is None or kl.class_name in ("InputLayer", "Flatten"):
+            continue
+        if kl.class_name in _NO_WEIGHT_LAYERS:
+            our_idx += 1
+            continue
+        grp = weights_root[kname]
+        wnames = grp.attrs.get("weight_names", [])
+        weights = {wn.split("/")[-1]: np.asarray(grp[wn].value) for wn in wnames}
+        _assign_layer_weights(net.params[str(our_idx)],
+                              net.states[str(our_idx)], kl, weights)
+        our_idx += 1
+    return net
+
+
+def _copy_weights_graph(net, weights_root, layer_names, keras_layers):
+    """Functional-model weight copy (our vertices indexed by name)."""
+    for kname in layer_names:
+        kl = next((l for l in keras_layers if l.name == kname), None)
+        if kl is None or kl.class_name in ("InputLayer", "Flatten", "Merge") \
+                or kl.class_name in _NO_WEIGHT_LAYERS:
+            continue
+        if kname not in net.params:
+            continue
+        grp = weights_root[kname]
+        wnames = grp.attrs.get("weight_names", [])
+        weights = {wn.split("/")[-1]: np.asarray(grp[wn].value) for wn in wnames}
+        _assign_layer_weights(net.params[kname], net.states.get(kname, {}),
+                              kl, weights)
+    return net
+
+
+class KerasModelImport:
+    """Entry points (reference: KerasModelImport.java:48-299)."""
+
+    @staticmethod
+    def import_keras_sequential_model_and_weights(path, enforce_training_config=False):
+        root = hdf5_lite.load(path)
+        config = json.loads(root.attrs["model_config"])
+        if config["class_name"] != "Sequential":
+            raise ValueError("not a Sequential model; use "
+                             "import_keras_model_and_weights")
+        keras_layers = [KerasLayer(lc["class_name"], lc["config"])
+                        for lc in config["config"]]
+        training = None
+        if "training_config" in root.attrs:
+            training = json.loads(root.attrs["training_config"])
+        loss = training.get("loss") if training else None
+
+        layers, input_type = _map_layers(keras_layers, loss=loss)
+        from ..nn.conf.configuration import NeuralNetConfiguration
+        from ..nn.updaters import Sgd
+        b = NeuralNetConfiguration.builder().updater(Sgd(0.01)).list()
+        for l in layers:
+            b.layer(l)
+        if input_type is not None:
+            b.set_input_type(input_type)
+        from ..nn.multilayer.network import MultiLayerNetwork
+        net = MultiLayerNetwork(b.build()).init()
+
+        weights_root = root["model_weights"] if "model_weights" in root else root
+        layer_names = weights_root.attrs.get("layer_names",
+                                             [l.name for l in keras_layers])
+        _copy_weights(net, weights_root, layer_names, keras_layers)
+        return net
+
+    @staticmethod
+    def import_keras_model_and_weights(path, enforce_training_config=False):
+        root = hdf5_lite.load(path)
+        config = json.loads(root.attrs["model_config"])
+        if config["class_name"] == "Sequential":
+            return KerasModelImport.import_keras_sequential_model_and_weights(
+                path, enforce_training_config)
+        return KerasModelImport._import_functional(root, config)
+
+    @staticmethod
+    def _import_functional(root, config):
+        """Keras 1.x functional Model -> ComputationGraph (reference:
+        KerasModel.getComputationGraphConfiguration :358)."""
+        from ..nn.conf.configuration import NeuralNetConfiguration
+        from ..nn.conf.graph_configuration import (MergeVertex,
+                                                   ElementWiseVertex)
+        from ..nn.conf.inputs import InputType
+        from ..nn.updaters import Sgd
+        from ..nn.graph.graph import ComputationGraph
+
+        cfg = config["config"]
+        klayers = [KerasLayer(lc["class_name"], lc["config"]) for lc in
+                   cfg["layers"]]
+        inbound = {}
+        for lc, kl in zip(cfg["layers"], klayers):
+            nodes = lc.get("inbound_nodes", [])
+            inbound[kl.name] = [n[0] for n in nodes[0]] if nodes else []
+        input_names = [n[0] for n in cfg["input_layers"]]
+        output_names = [n[0] for n in cfg["output_layers"]]
+
+        gb = (NeuralNetConfiguration.builder().updater(Sgd(0.01))
+              .graph_builder())
+        gb.add_inputs(*input_names)
+        input_types = []
+        for kl in klayers:
+            if kl.name not in input_names:
+                continue
+            shape = kl.config.get("batch_input_shape")
+            dims = shape[1:] if shape else []
+            if len(dims) == 1:
+                input_types.append(InputType.feed_forward(dims[0]))
+            elif len(dims) == 2:
+                input_types.append(InputType.recurrent(dims[1]))
+            elif len(dims) == 3:
+                if kl.config.get("dim_ordering", "tf") == "th":
+                    c, h, w = dims
+                else:
+                    h, w, c = dims
+                input_types.append(InputType.convolutional(h, w, c))
+        for kl in klayers:
+            if kl.class_name == "InputLayer":
+                continue
+            srcs = inbound[kl.name]
+            if kl.class_name == "Merge":
+                mode = kl.config.get("mode", "concat")
+                vtx = MergeVertex() if mode == "concat" else \
+                    ElementWiseVertex(op="add" if mode == "sum" else mode)
+                gb.add_vertex(kl.name, vtx, *srcs)
+                continue
+            confs, _ = _map_layers([kl])
+            if not confs:   # Flatten/pass-through
+                # splice: downstream consumers read from this vertex's input
+                for other in inbound.values():
+                    for i, s in enumerate(other):
+                        if s == kl.name:
+                            other[i] = srcs[0]
+                continue
+            gb.add_layer(kl.name, confs[0], *srcs)
+        gb.set_outputs(*output_names)
+        if input_types:
+            gb.set_input_types(*input_types)
+        net = ComputationGraph(gb.build()).init()
+
+        weights_root = root["model_weights"] if "model_weights" in root else root
+        layer_names = weights_root.attrs.get("layer_names",
+                                             [l.name for l in klayers])
+        _copy_weights_graph(net, weights_root, layer_names, klayers)
+        return net
+
+    # reference overload aliases
+    import_keras_model = import_keras_model_and_weights
+    import_keras_sequential_model = import_keras_sequential_model_and_weights
+
+
+def export_keras_sequential(net, path):
+    """Write a Keras-1.x-layout h5 for a Sequential-compatible
+    MultiLayerNetwork (fixture generator + interop export; inverse of the
+    import path)."""
+    from ..nn.conf import layers as L
+    f = hdf5_lite.H5File()
+    keras_layers = []
+    weight_groups = {}
+    for i, lc in enumerate(net.conf.layers):
+        p = net.params[str(i)]
+        name = f"layer_{i}"
+        if isinstance(lc, (L.DenseLayer, L.OutputLayer)) and \
+                not isinstance(lc, L.RnnOutputLayer):
+            keras_layers.append({"class_name": "Dense", "config": {
+                "name": name, "output_dim": int(lc.n_out),
+                "activation": _inv_act(lc.activation)}})
+            weight_groups[name] = {f"{name}_W": np.asarray(p["W"]),
+                                   f"{name}_b": np.asarray(p["b"])}
+        elif isinstance(lc, L.ConvolutionLayer):
+            keras_layers.append({"class_name": "Convolution2D", "config": {
+                "name": name, "nb_filter": int(lc.n_out),
+                "nb_row": int(lc.kernel_size[0]), "nb_col": int(lc.kernel_size[1]),
+                "subsample": list(lc.stride),
+                "border_mode": "same" if lc.convolution_mode == "same" else "valid",
+                "dim_ordering": "tf",
+                "activation": _inv_act(lc.activation)}})
+            weight_groups[name] = {f"{name}_W": np.asarray(p["W"]),
+                                   f"{name}_b": np.asarray(p["b"])}
+        elif isinstance(lc, L.SubsamplingLayer):
+            keras_layers.append({
+                "class_name": "MaxPooling2D" if lc.pooling_type == "max"
+                else "AveragePooling2D",
+                "config": {"name": name, "pool_size": list(lc.kernel_size),
+                           "strides": list(lc.stride),
+                           "border_mode": "same" if lc.convolution_mode == "same"
+                           else "valid"}})
+            weight_groups[name] = {}
+        else:
+            raise ValueError(f"export: unsupported layer {type(lc).__name__}")
+    # batch_input_shape on the first layer
+    it = net.conf.input_type
+    if it is not None:
+        if it.kind == "ff":
+            shape = [None, int(it.size)]
+        elif it.kind == "cnn":
+            shape = [None, int(it.height), int(it.width), int(it.channels)]
+        else:
+            shape = [None, None, int(it.size)]
+        keras_layers[0]["config"]["batch_input_shape"] = shape
+
+    f.attrs["keras_version"] = np.bytes_(b"1.2.2")
+    f.attrs["model_config"] = np.bytes_(json.dumps(
+        {"class_name": "Sequential", "config": keras_layers}).encode())
+    maxlen = max(len(k) for k in weight_groups) + 1
+    f.attrs["layer_names"] = np.array(
+        [k.encode() for k in weight_groups], dtype=f"S{maxlen}")
+    for name, ws in weight_groups.items():
+        g = f.create_group(name)
+        if ws:
+            wl = max(len(k) for k in ws) + 1
+            g.attrs["weight_names"] = np.array([k.encode() for k in ws],
+                                               dtype=f"S{wl}")
+        else:
+            g.attrs["weight_names"] = np.array([], dtype="S1")
+        for wn, arr in ws.items():
+            g.create_dataset(wn, arr.astype(np.float32))
+    f.save(path)
+    return path
+
+
+def _inv_act(act):
+    inv = {v: k for k, v in _KERAS_ACTIVATIONS.items()}
+    inv["identity"] = "linear"
+    return inv.get(act, act)
