@@ -96,8 +96,11 @@ class KMeansClustering:
         chosen = [first]
         d2 = ((x - x[first]) ** 2).sum(-1)
         for _ in range(1, self.k):
-            probs = d2 / max(d2.sum(), 1e-12)
-            nxt = int(rng.choice(len(x), p=probs))
+            total = d2.sum()
+            if total > 0:
+                nxt = int(rng.choice(len(x), p=d2 / total))
+            else:  # all remaining points coincide with a center — pick uniformly
+                nxt = int(rng.integers(len(x)))
             chosen.append(nxt)
             d2 = np.minimum(d2, ((x - x[nxt]) ** 2).sum(-1))
         centers = jnp.asarray(x[np.array(chosen)])

@@ -132,12 +132,25 @@ class H5Group:
         self.datasets = {}   # name -> np.ndarray
 
     def create_group(self, name):
-        g = H5Group()
-        self.groups[name] = g
-        return g
+        name = name.strip("/")
+        if "/" in name:   # intermediate groups, like h5py
+            head, rest = name.split("/", 1)
+            return self.create_group(head).create_group(rest)
+        if name in self.datasets:
+            raise ValueError(f"a dataset named {name!r} already exists")
+        if name not in self.groups:
+            self.groups[name] = H5Group()
+        return self.groups[name]
 
     def create_dataset(self, name, data):
-        self.datasets[name] = np.asarray(data)
+        name = name.strip("/")
+        if "/" in name:
+            path, leaf = name.rsplit("/", 1)
+            self.create_group(path).create_dataset(leaf, data)
+        else:
+            if name in self.groups:
+                raise ValueError(f"a group named {name!r} already exists")
+            self.datasets[name] = np.asarray(data)
 
 
 class H5File(H5Group):
@@ -201,19 +214,34 @@ def _write_group(w, group):
             heap_data.extend(nb + b"\x00" * _pad8(len(nb)))
         heap_seg_addr = w.write(bytes(heap_data))
         heap_addr = w.write(b"HEAP" + struct.pack("<Bxxx", 0) +
-                            struct.pack("<QQQ", len(heap_data), UNDEF,
-                                        heap_seg_addr))
-        snod = b"SNOD" + struct.pack("<BxH", 1, len(entries))
-        for (name, hdr_addr), off in zip(entries, offsets):
-            snod += struct.pack("<QQI4x16x", off, hdr_addr, 0)
-        snod_addr = w.write(snod)
-        k_leaf = 4
-        btree = b"TREE" + struct.pack("<BBH", 0, 0, 1)
+                            struct.pack("<QQQ", len(heap_data), 1,
+                                        heap_seg_addr))  # free-list head 1 = empty
+        # split symbols across SNODs of <=2*K_leaf entries each (superblock
+        # declares group-leaf K=4), one level-0 TREE node sized for the
+        # declared group-internal K=16 (33 key + 32 child slots)
+        k_leaf, k_int = 4, 16
+        max_per_snod = 2 * k_leaf
+        if len(entries) > max_per_snod * 2 * k_int:
+            raise ValueError(f"group with {len(entries)} children exceeds the "
+                             f"single-level B-tree capacity "
+                             f"({max_per_snod * 2 * k_int})")
+        snod_addrs, last_offs = [], []
+        pairs = list(zip(entries, offsets))
+        for i in range(0, max(len(entries), 1), max_per_snod):
+            chunk = pairs[i:i + max_per_snod]
+            snod = b"SNOD" + struct.pack("<BxH", 1, len(chunk))
+            for (name, hdr_addr), off in chunk:
+                snod += struct.pack("<QQI4x16x", off, hdr_addr, 0)
+            snod_addrs.append(w.write(snod))
+            last_offs.append(chunk[-1][1] if chunk else 0)
+        btree = b"TREE" + struct.pack("<BBH", 0, 0, len(snod_addrs))
         btree += struct.pack("<QQ", UNDEF, UNDEF)
         btree += struct.pack("<Q", 0)          # key 0: lowest name offset
-        btree += struct.pack("<Q", snod_addr)  # child 0
-        btree += struct.pack("<Q", offsets[-1] if offsets else 0)  # key 1
-        btree += b"\x00" * (2 * k_leaf - 1) * 16  # unused key/child slots
+        for snod_addr, last_off in zip(snod_addrs, last_offs):
+            btree += struct.pack("<Q", snod_addr)
+            btree += struct.pack("<Q", last_off)  # key i+1: last name in child i
+        used = 1 + 2 * len(snod_addrs)            # key/child slots written
+        btree += b"\x00" * ((2 * k_int + 1 + 2 * k_int) - used) * 8
         btree_addr = w.write(btree)
         msgs.insert(0, _message(0x0011, struct.pack("<QQ", btree_addr, heap_addr)))
     return _write_object_header(w, msgs)
@@ -245,6 +273,8 @@ class H5Object:
         return name in self._links
 
     def __getitem__(self, name):
+        if name is Ellipsis:    # h5py-style ds[...] read
+            return self.value
         if "/" in name:
             head, rest = name.split("/", 1)
             obj = self[head] if head else self

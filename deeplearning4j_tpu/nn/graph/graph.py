@@ -356,6 +356,15 @@ class ComputationGraph:
                 carries[name] = layer.init_carry(batch, self._dtype)
         return carries
 
+    def clone(self):
+        """Deep copy (params/states/score); mirrors MultiLayerNetwork.clone —
+        required by the early-stopping InMemoryModelSaver."""
+        net = ComputationGraph(self.conf)
+        if self.params is not None:
+            net.init(params=jax.tree_util.tree_map(jnp.array, self.params))
+            net.states = jax.tree_util.tree_map(jnp.array, self.states)
+        return net
+
     # -------------------------------------------------------------- params
     def param_table(self):
         out = {}

@@ -96,22 +96,22 @@ class BaseFlatSolver:
                                 mask=mask, label_mask=label_mask)
 
     def _fns(self, x, y, mask, label_mask):
-        key = _shapes_key(x, y)
         treedef, shapes, sizes = _flatten_spec(self.model.params)
-        if key in self._fns_cache:
-            return (treedef, shapes, sizes), *self._fns_cache[key]
+        key = (_shapes_key(x, y), tuple(shapes))
+        if key not in self._fns_cache:
+            def loss_vec(vec, x, y, mask, label_mask, states):
+                p = _unravel(vec, treedef, shapes, sizes)
+                s, _ = self._call_loss(p, states, x, y, mask, label_mask, False)
+                return s
+
+            self._fns_cache[key] = (jax.jit(jax.value_and_grad(loss_vec)),
+                                    jax.jit(loss_vec))
+        # only the compiled fns are cached; the batch and layer states are
+        # bound per call, so every fit_batch optimizes the CURRENT minibatch
+        vg, score = self._fns_cache[key]
         states = self.model.states
-
-        def loss_vec(vec, x, y):
-            p = _unravel(vec, treedef, shapes, sizes)
-            s, _ = self._call_loss(p, states, x, y, mask, label_mask, False)
-            return s
-
-        vg = jax.jit(jax.value_and_grad(loss_vec))
-        score = jax.jit(loss_vec)
-        vg_b = lambda w: vg(w, x, y)
-        score_b = lambda w: score(w, x, y)
-        self._fns_cache[key] = (vg_b, score_b)
+        vg_b = lambda w: vg(w, x, y, mask, label_mask, states)
+        score_b = lambda w: score(w, x, y, mask, label_mask, states)
         return (treedef, shapes, sizes), vg_b, score_b
 
     def optimize(self, x, y, mask=None, label_mask=None):

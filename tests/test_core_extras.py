@@ -223,3 +223,13 @@ def test_concurrent_hash_set():
     assert "a" in s and len(s) == 1
     s.remove("a")
     assert len(s) == 0
+
+
+def test_kmeans_all_identical_points():
+    """Regression: k-means++ D^2 sampling degenerates to all-zero probabilities
+    when every point coincides (ADVICE.md round 1, low)."""
+    from deeplearning4j_tpu.clustering.kmeans import KMeansClustering
+    x = np.ones((12, 3), np.float32)
+    cs = KMeansClustering(k=3, seed=7).fit(x)
+    assert len(cs.centers) == 3
+    np.testing.assert_allclose(np.asarray(cs.centers), 1.0)

@@ -246,3 +246,51 @@ def test_flat_solver_updates_batchnorm_stats():
         for a, b in zip(jax.tree_util.tree_leaves(before),
                         jax.tree_util.tree_leaves(after)))
     assert changed, "BatchNorm running stats must update under flat solvers"
+
+
+def test_flat_solver_optimizes_current_batch_not_first():
+    """Regression: the compiled solver fns must bind the CURRENT minibatch —
+    a shape-keyed cache that captured the first batch silently optimized that
+    batch forever (ADVICE.md round 1, high)."""
+    net = _toy_net(algo=OptimizationAlgorithm.LBFGS)
+    rng = np.random.default_rng(21)
+    x1 = rng.normal(size=(32, 4))
+    y1 = np.eye(2)[(x1.sum(1) > 0).astype(int)]
+    x2 = rng.normal(size=(32, 4))
+    y2 = np.eye(2)[(x2.sum(1) < 0).astype(int)]  # opposite labelling
+    net.fit_batch(DataSet(x1, y1))               # fills the shape-keyed cache
+    s2_before = net.score(x2, y2)
+    for _ in range(10):
+        net.fit_batch(DataSet(x2, y2))
+    assert net.score(x2, y2) < s2_before, \
+        "second batch's loss must go down when fitting the second batch"
+    assert len(net._flat_solver._fns_cache) == 1  # same shapes -> one executable
+
+
+def test_early_stopping_graph_trainer_in_memory_saver():
+    """Regression: ComputationGraph.clone() must exist so the default
+    InMemoryModelSaver can snapshot the best graph (ADVICE.md round 1, medium)."""
+    from deeplearning4j_tpu import ComputationGraph
+    from deeplearning4j_tpu.earlystopping import EarlyStoppingGraphTrainer
+    conf = (NeuralNetConfiguration.builder().seed(3)
+            .updater(Sgd(0.2))
+            .graph_builder()
+            .add_inputs("in")
+            .add_layer("d", DenseLayer(n_out=8, activation="tanh"), "in")
+            .add_layer("out", OutputLayer(n_out=2, activation="softmax",
+                                          loss="MCXENT"), "d")
+            .set_outputs("out")
+            .set_input_types(InputType.feed_forward(4))
+            .build())
+    g = ComputationGraph(conf).init()
+    cfg = (EarlyStoppingConfiguration.builder()
+           .epoch_termination_conditions(MaxEpochsTerminationCondition(3))
+           .score_calculator(DataSetLossCalculator(_toy_data(seed=2)))
+           .model_saver(InMemoryModelSaver())
+           .build())
+    result = EarlyStoppingGraphTrainer(cfg, g, _toy_data(seed=2)).fit()
+    best = result.best_model
+    assert best is not None and best is not g
+    x = np.asarray(_toy_data(seed=2).next().features)
+    np.testing.assert_allclose(np.asarray(best.output(x)[0]),
+                               np.asarray(g.output(x)[0]), atol=1e-6)
