@@ -102,6 +102,11 @@ class MultiLayerConfiguration:
     tbptt_back_length: int = 20
     seed: int = 12345
     dtype: str = "float32"
+    # compute (activation/matmul) dtype for mixed precision; None = same as
+    # dtype. "bfloat16" keeps f32 master params + BN stats + loss while the
+    # MXU-bound forward/backward runs in bf16 (TPU-native mixed precision —
+    # the reference's analog is the fp16 cuDNN bypass, ConvolutionLayer.java:158)
+    compute_dtype: object = None
     optimization_algo: str = OptimizationAlgorithm.STOCHASTIC_GRADIENT_DESCENT
     max_num_line_search_iterations: int = 5
     pretrain: bool = False
@@ -120,6 +125,7 @@ class MultiLayerConfiguration:
             "tbptt_back_length": self.tbptt_back_length,
             "seed": self.seed,
             "dtype": self.dtype,
+            "compute_dtype": self.compute_dtype,
             "optimization_algo": self.optimization_algo,
             "max_num_line_search_iterations": self.max_num_line_search_iterations,
             "pretrain": self.pretrain,
@@ -138,8 +144,8 @@ class MultiLayerConfiguration:
         it = d.get("input_type")
         conf.input_type = InputType.from_dict(it) if it else None
         for k in ("backprop_type", "tbptt_fwd_length", "tbptt_back_length", "seed",
-                  "dtype", "optimization_algo", "max_num_line_search_iterations",
-                  "pretrain", "backprop"):
+                  "dtype", "compute_dtype", "optimization_algo",
+                  "max_num_line_search_iterations", "pretrain", "backprop"):
             if k in d:
                 setattr(conf, k, d[k])
         return conf
@@ -216,6 +222,7 @@ class ListBuilder:
             tbptt_back_length=self._tbptt_back,
             seed=g.get("seed", 12345),
             dtype=g.get("dtype", "float32"),
+            compute_dtype=g.get("compute_dtype"),
             optimization_algo=g.get("optimization_algo",
                                     OptimizationAlgorithm.STOCHASTIC_GRADIENT_DESCENT),
             max_num_line_search_iterations=g.get("max_num_line_search_iterations", 5),
@@ -319,6 +326,13 @@ class NeuralNetConfigurationBuilder:
 
     def dtype(self, dt):
         self._g["dtype"] = str(dt)
+        return self
+
+    def compute_dtype(self, dt):
+        """Mixed precision: run forward/backward math in `dt` (e.g. "bfloat16")
+        while parameters, optimizer state, BatchNorm statistics, and the loss
+        stay in `dtype`."""
+        self._g["compute_dtype"] = None if dt is None else str(dt)
         return self
 
     def regularization(self, flag):

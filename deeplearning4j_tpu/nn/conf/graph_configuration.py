@@ -309,6 +309,7 @@ class ComputationGraphConfiguration:
     tbptt_back_length: int = 20
     seed: int = 12345
     dtype: str = "float32"
+    compute_dtype: object = None   # mixed precision (see MultiLayerConfiguration)
     optimization_algo: str = "sgd"
     max_num_line_search_iterations: int = 5
     topological_order: list = None
@@ -358,6 +359,7 @@ class ComputationGraphConfiguration:
             "tbptt_back_length": self.tbptt_back_length,
             "seed": self.seed,
             "dtype": self.dtype,
+            "compute_dtype": self.compute_dtype,
             "optimization_algo": self.optimization_algo,
             "max_num_line_search_iterations": self.max_num_line_search_iterations,
         }
@@ -380,7 +382,8 @@ class ComputationGraphConfiguration:
         if d.get("input_types"):
             conf.input_types = [InputType.from_dict(t) for t in d["input_types"]]
         for k in ("backprop_type", "tbptt_fwd_length", "tbptt_back_length", "seed",
-                  "dtype", "optimization_algo", "max_num_line_search_iterations"):
+                  "dtype", "compute_dtype", "optimization_algo",
+                  "max_num_line_search_iterations"):
             if k in d:
                 setattr(conf, k, d[k])
         return conf
@@ -398,6 +401,7 @@ class GraphBuilder:
         self._conf = ComputationGraphConfiguration(
             seed=global_conf.get("seed", 12345),
             dtype=global_conf.get("dtype", "float32"),
+            compute_dtype=global_conf.get("compute_dtype"),
             optimization_algo=global_conf.get("optimization_algo", "sgd"),
             max_num_line_search_iterations=global_conf.get(
                 "max_num_line_search_iterations", 5))

@@ -40,11 +40,25 @@ class ComputationGraph:
         self.listeners = []
         self.iteration_count = 0
         self.epoch_count = 0
-        self.score_value = float("nan")
+        self._score_dev = float("nan")
         self._dtype = jnp.dtype(conf.dtype)
         self._rng = jax.random.PRNGKey(conf.seed)
         self._jit_cache = {}
         self._rnn_state = {}
+
+    @property
+    def score_value(self):
+        """Most recent minibatch score; kept on device by the train step and
+        synced to host lazily on first read (mirrors MultiLayerNetwork)."""
+        s = self._score_dev
+        if not isinstance(s, float):
+            s = float(s)
+            self._score_dev = s
+        return s
+
+    @score_value.setter
+    def score_value(self, v):
+        self._score_dev = v
 
     # ------------------------------------------------------------------ init
     def init(self, params=None):
@@ -144,10 +158,33 @@ class ComputationGraph:
                 out_masks[name] = vc.output_mask(ms)
         return acts, new_states, out_masks, carries
 
+    # ------------------------------------------------------- mixed precision
+    def _compute_dtype(self):
+        cd = getattr(self.conf, "compute_dtype", None)
+        if cd is None or jnp.dtype(cd) == self._dtype:
+            return None
+        return jnp.dtype(cd)
+
+    def _cast_for_compute(self, params, inputs):
+        """bf16 compute for all non-output layers; output layers keep the
+        param dtype so their loss math runs in full precision (mirrors
+        MultiLayerNetwork._cast_for_compute)."""
+        cd = self._compute_dtype()
+        if cd is None:
+            return params, inputs
+        outs = set(self.conf.network_outputs)
+        cast = lambda a: a.astype(cd) \
+            if hasattr(a, "dtype") and jnp.issubdtype(a.dtype, jnp.floating) else a
+        params = {k: (v if k in outs else jax.tree_util.tree_map(cast, v))
+                  for k, v in params.items()}
+        inputs = [cast(x) for x in inputs]
+        return params, inputs
+
     # ---------------------------------------------------------------- loss
     def _loss(self, params, states, inputs, labels, *, train, rng, masks=None,
               label_masks=None, initial_carries=None):
         conf = self.conf
+        params, inputs = self._cast_for_compute(params, inputs)
         # run everything except output layers' score; output layer forward is
         # replaced by its integrated loss on the features feeding it.
         acts, new_states, out_masks, carries = self._forward(
@@ -163,6 +200,8 @@ class ComputationGraph:
             feats = acts[spec.inputs[0]]
             if spec.preprocessor is not None:
                 feats = spec.preprocessor(feats, out_masks.get(spec.inputs[0]))
+            if self._compute_dtype() is not None:
+                feats = feats.astype(self._dtype)  # loss math in full precision
             mask = mlab if mlab is not None else out_masks.get(spec.inputs[0])
             if isinstance(layer, feedforward.CenterLossOutputLayerModule):
                 total = total + layer.score(params[out_name], feats, y, mask, train,
@@ -281,7 +320,7 @@ class ComputationGraph:
         self.params, self.opt_state, self.states, score = step(
             self.params, self.opt_state, self.states, step_rng, inputs, labels,
             masks, lmasks)
-        self.score_value = float(score)
+        self.score_value = score  # device scalar; syncs lazily on read
         self.iteration_count += 1
         for listener in self.listeners:
             listener.iteration_done(self, self.iteration_count)
@@ -295,8 +334,9 @@ class ComputationGraph:
         key = ("output", len(inputs))
         if key not in self._jit_cache:
             def fwd(params, states, xs):
+                params, xs = self._cast_for_compute(params, xs)
                 acts, _, _, _ = self._forward(params, states, xs, train=False, rng=None)
-                return [acts[o] for o in self.conf.network_outputs]
+                return [acts[o].astype(self._dtype) for o in self.conf.network_outputs]
             self._jit_cache[key] = jax.jit(fwd)
         outs = self._jit_cache[key](self.params, self.states, inputs)
         return outs[0] if len(outs) == 1 else outs

@@ -148,9 +148,18 @@ class BatchNormalizationModule(BaseLayerModule):
     def forward(self, params, state, x, *, train=False, rng=None, mask=None):
         c = self.conf
         axes = tuple(range(x.ndim - 1))  # all but channel/feature
+        # statistics ACCUMULATE in the state dtype (f32 under bf16 mixed
+        # precision — bf16 accumulation loses the small batch-to-batch deltas
+        # the running stats depend on), but the per-element normalization
+        # stays in the input dtype so the channel-sized scale/shift fuses into
+        # the surrounding bf16 elementwise chain without f32 HBM traffic
+        in_dt = x.dtype
+        stat_dt = state["mean"].dtype
         if train:
-            mean = jnp.mean(x, axis=axes)
-            var = jnp.var(x, axis=axes)
+            mean = jnp.mean(x, axis=axes, dtype=stat_dt)
+            # two-pass variance: diffs in the input dtype, f32 accumulation
+            var = jnp.mean(jnp.square(x - mean.astype(in_dt)), axis=axes,
+                           dtype=stat_dt)
             decay = c.decay
             new_state = {
                 "mean": decay * state["mean"] + (1 - decay) * mean,
@@ -159,12 +168,14 @@ class BatchNormalizationModule(BaseLayerModule):
         else:
             mean, var = state["mean"], state["var"]
             new_state = state
-        inv = lax.rsqrt(var + c.eps)
-        y = (x - mean) * inv
+        inv = lax.rsqrt(var + c.eps)          # f32, channel-sized
         if "gamma" in params:
-            y = y * params["gamma"] + params["beta"]
+            scale = params["gamma"].astype(stat_dt) * inv
+            shift = params["beta"].astype(stat_dt) - mean * scale
         else:
-            y = y * c.gamma + c.beta
+            scale = c.gamma * inv
+            shift = c.beta - mean * scale
+        y = x * scale.astype(in_dt) + shift.astype(in_dt)
         return self.activation_fn()(y), new_state, mask
 
 

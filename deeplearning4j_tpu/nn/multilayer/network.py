@@ -44,12 +44,28 @@ class MultiLayerNetwork:
         self.listeners = []
         self.iteration_count = 0
         self.epoch_count = 0
-        self.score_value = float("nan")
+        self._score_dev = float("nan")
         self.last_gradients = None   # most recent step's gradients (StatsListener)
         self._dtype = jnp.dtype(conf.dtype)
         self._rng = jax.random.PRNGKey(conf.seed)
         self._rnn_state = {}        # streaming inference carries per layer idx
         self._jit_cache = {}
+
+    @property
+    def score_value(self):
+        """Most recent minibatch score. The train step leaves the score ON
+        DEVICE (a host readback through the TPU runtime costs orders of
+        magnitude more than the step itself); the device→host sync happens
+        lazily here, only when something actually reads the score."""
+        s = self._score_dev
+        if not isinstance(s, float):
+            s = float(s)
+            self._score_dev = s
+        return s
+
+    @score_value.setter
+    def score_value(self, v):
+        self._score_dev = v
 
     # ------------------------------------------------------------------ init
     def init(self, params=None):
@@ -124,15 +140,47 @@ class MultiLayerNetwork:
                 collected.append(x)
         return x, new_states, cur_mask, carries, collected
 
+    # ------------------------------------------------------- mixed precision
+    def _compute_dtype(self):
+        """Mixed-precision compute dtype, or None when compute == param dtype."""
+        cd = getattr(self.conf, "compute_dtype", None)
+        if cd is None or jnp.dtype(cd) == self._dtype:
+            return None
+        return jnp.dtype(cd)
+
+    @staticmethod
+    def _cast_floats(tree, dt):
+        return jax.tree_util.tree_map(
+            lambda a: a.astype(dt)
+            if hasattr(a, "dtype") and jnp.issubdtype(a.dtype, jnp.floating) else a,
+            tree)
+
+    def _cast_for_compute(self, params, x, *, keep_f32=()):
+        """Cast params + input to the compute dtype for the MXU-bound layers;
+        layers named in keep_f32 (the output/loss layers) keep the param dtype
+        so softmax/cross-entropy run in full precision. BatchNorm statistics
+        stay f32 inside the layer itself (layers/convolution.py)."""
+        cd = self._compute_dtype()
+        if cd is None:
+            return params, x
+        params = {k: (v if k in keep_f32 else self._cast_floats(v, cd))
+                  for k, v in params.items()}
+        if hasattr(x, "dtype") and jnp.issubdtype(x.dtype, jnp.floating):
+            x = x.astype(cd)
+        return params, x
+
     # ------------------------------------------------------------- loss/score
     def _loss(self, params, states, x, y, *, train, rng, mask=None, label_mask=None,
               initial_carries=None):
         out_idx = len(self.layers) - 1
+        params, x = self._cast_for_compute(params, x, keep_f32=(str(out_idx),))
         feats, new_states, cur_mask, carries, _ = self._forward(
             params, states, x, train=train, rng=rng, mask=mask, to_layer=out_idx,
             initial_carries=initial_carries)
         out_layer = self.layers[out_idx]
         feats, cur_mask = self._apply_preprocessor(out_idx, feats, cur_mask)
+        if self._compute_dtype() is not None:
+            feats = feats.astype(self._dtype)  # loss math in full precision
         if not out_layer.is_output_layer():
             raise ValueError("Last layer is not an output/loss layer")
         lm = label_mask if label_mask is not None else cur_mask
@@ -252,7 +300,7 @@ class MultiLayerNetwork:
              self.last_gradients) = step(
                 self.params, self.opt_state, self.states, step_rng, x, y, mask,
                 lmask, None)
-            self.score_value = float(score)
+            self.score_value = score  # device scalar; syncs lazily on read
         self.iteration_count += 1
         for listener in self.listeners:
             if hasattr(listener, "record_batch_size"):
@@ -282,8 +330,9 @@ class MultiLayerNetwork:
              self.last_gradients) = step(
                 self.params, self.opt_state, self.states, sub, xw, yw, mw, lmw, carries)
             carries = jax.tree_util.tree_map(jax.lax.stop_gradient, carries)
-            scores.append(float(score))
-        self.score_value = float(np.mean(scores))
+            scores.append(score)
+        # mean stays on device; syncs lazily when score_value is read
+        self.score_value = jnp.mean(jnp.stack(scores))
 
     def _zero_carries(self, batch, dtype):
         carries = {}
@@ -305,9 +354,11 @@ class MultiLayerNetwork:
             is_train = bool(train)
 
             def fwd(params, states, xx):
+                params, xx = self._cast_for_compute(
+                    params, xx, keep_f32=(str(len(self.layers) - 1),))
                 out, _, _, _, _ = self._forward(params, states, xx, train=is_train,
                                                 rng=None)
-                return out
+                return out.astype(self._dtype)
             self._jit_cache[key] = jax.jit(fwd)
         return self._jit_cache[key](self.params, self.states, x)
 
@@ -414,7 +465,7 @@ class MultiLayerNetwork:
                 feats, _ = self._apply_preprocessor(idx, feats, None)
                 self._rng, sub = jax.random.split(self._rng)
                 lp, opt_state, loss = pstep(lp, opt_state, sub, feats)
-                self.score_value = float(loss)
+                self.score_value = loss  # device scalar; syncs lazily on read
         self.params[str(idx)] = lp
         return self
 

@@ -1,0 +1,99 @@
+"""Mixed-precision (compute_dtype=bfloat16) tests: f32 master params, BN
+statistics, and loss, with bf16 MXU-bound compute (SURVEY.md §4.1 tolerance
+tiers; the reference's analog is the fp16 cuDNN bypass ConvolutionLayer.java:158)."""
+import numpy as np
+import jax
+import pytest
+
+from deeplearning4j_tpu import (NeuralNetConfiguration, InputType, ConvolutionLayer,
+                                SubsamplingLayer, DenseLayer, OutputLayer,
+                                MultiLayerNetwork, DataSet, Adam, BatchNormalization)
+
+
+def _net(compute_dtype):
+    conf = (NeuralNetConfiguration.builder().seed(7).updater(Adam(1e-2))
+            .compute_dtype(compute_dtype).list()
+            .layer(ConvolutionLayer(kernel_size=(3, 3), n_out=8, activation="relu",
+                                    convolution_mode="same"))
+            .layer(BatchNormalization())
+            .layer(SubsamplingLayer(kernel_size=(2, 2), stride=(2, 2)))
+            .layer(DenseLayer(n_out=32, activation="relu"))
+            .layer(OutputLayer(n_out=4, activation="softmax", loss="MCXENT"))
+            .input_type(InputType.convolutional(8, 8, 1))
+            .build())
+    return MultiLayerNetwork(conf).init()
+
+
+def _data(n=16, seed=0):
+    rng = np.random.default_rng(seed)
+    x = rng.normal(size=(n, 8, 8, 1)).astype(np.float32)
+    y = np.eye(4, dtype=np.float32)[rng.integers(0, 4, n)]
+    return x, y
+
+
+def test_bf16_training_converges_with_f32_master_state():
+    x, y = _data()
+    net = _net("bfloat16")
+    s0 = net.score(x, y)
+    for _ in range(20):
+        net.fit_batch(DataSet(x, y))
+    assert net.score_value < 0.5 * s0
+    # master params / opt state / BN stats stay f32
+    for tree in (net.params, net.states, net.opt_state):
+        for leaf in jax.tree_util.tree_leaves(tree):
+            if hasattr(leaf, "dtype") and np.issubdtype(leaf.dtype, np.floating):
+                assert leaf.dtype == np.float32, leaf.dtype
+
+
+def test_bf16_matches_f32_within_tolerance():
+    x, y = _data()
+    n32, n16 = _net(None), _net("bfloat16")
+    for _ in range(10):
+        n32.fit_batch(DataSet(x, y))
+        n16.fit_batch(DataSet(x, y))
+    o32 = np.asarray(n32.output(x))
+    o16 = np.asarray(n16.output(x))
+    assert o16.dtype == np.float32
+    # probabilities must agree to bf16-tier tolerance after identical training
+    assert np.abs(o32 - o16).max() < 0.05
+
+
+def test_bf16_computation_graph():
+    from deeplearning4j_tpu import ComputationGraph
+    conf = (NeuralNetConfiguration.builder().seed(9).updater(Adam(1e-2))
+            .compute_dtype("bfloat16")
+            .graph_builder()
+            .add_inputs("in")
+            .add_layer("d", DenseLayer(n_out=16, activation="relu"), "in")
+            .add_layer("out", OutputLayer(n_out=4, activation="softmax",
+                                          loss="MCXENT"), "d")
+            .set_outputs("out")
+            .set_input_types(InputType.feed_forward(8))
+            .build())
+    g = ComputationGraph(conf).init()
+    rng = np.random.default_rng(1)
+    x = rng.normal(size=(16, 8)).astype(np.float32)
+    y = np.eye(4, dtype=np.float32)[rng.integers(0, 4, 16)]
+    s0 = g.score(DataSet(x, y))
+    for _ in range(20):
+        g.fit_batch(DataSet(x, y))
+    assert g.score_value < 0.5 * s0
+    out = np.asarray(g.output(x))
+    assert out.dtype == np.float32
+    for leaf in jax.tree_util.tree_leaves(g.params):
+        assert leaf.dtype == np.float32
+    # compute_dtype survives the config JSON round-trip (checkpoint contract)
+    from deeplearning4j_tpu.nn.conf.graph_configuration import ComputationGraphConfiguration
+    assert ComputationGraphConfiguration.from_json(conf.to_json()).compute_dtype == "bfloat16"
+
+
+def test_score_stays_on_device_until_read():
+    """The train step must not force a device->host sync; score_value syncs
+    lazily (remote-TPU readbacks cost ~100ms+ each)."""
+    x, y = _data()
+    net = _net(None)
+    net.fit_batch(DataSet(x, y))
+    assert not isinstance(net._score_dev, float)   # still a device scalar
+    s = net.score_value                            # first read syncs...
+    assert isinstance(s, float) and np.isfinite(s)
+    assert isinstance(net._score_dev, float)       # ...and caches the float
