@@ -1,0 +1,17 @@
+"""Graph embeddings: graph API, random walks, DeepWalk.
+
+TPU-native counterpart of the reference's `deeplearning4j-graph` module
+(deeplearning4j-graph/src/main/java/org/deeplearning4j/graph/): graph
+structure and walk generation stay on host; embedding training runs as
+batched XLA scatter updates (see deepwalk.py).
+"""
+from .graph import Vertex, Edge, IGraph, Graph, GraphLoader, NoEdgesError
+from .iterator import (NoEdgeHandling, GraphWalkIterator, RandomWalkIterator,
+                       WeightedRandomWalkIterator)
+from .deepwalk import GraphHuffman, GraphVectors, DeepWalk
+
+__all__ = [
+    "Vertex", "Edge", "IGraph", "Graph", "GraphLoader", "NoEdgesError",
+    "NoEdgeHandling", "GraphWalkIterator", "RandomWalkIterator",
+    "WeightedRandomWalkIterator", "GraphHuffman", "GraphVectors", "DeepWalk",
+]

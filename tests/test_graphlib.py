@@ -1,0 +1,157 @@
+"""Graph embeddings tests.
+
+Mirrors the reference's deeplearning4j-graph test suite
+(deeplearning4j-graph/src/test/java/org/deeplearning4j/graph/):
+TestGraph.java (structure), TestGraphHuffman.java (coding invariants),
+DeepWalkGradientCheck.java / TestDeepWalk.java (embedding quality on
+clustered toy graphs, save/load round-trip).
+"""
+import os
+
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu.graphlib import (
+    Graph, Edge, GraphLoader, NoEdgesError, NoEdgeHandling,
+    RandomWalkIterator, WeightedRandomWalkIterator, GraphHuffman, DeepWalk,
+    GraphVectors,
+)
+
+
+def _two_cluster_graph(k=6):
+    """Two complete K_k clusters joined by a single bridge edge."""
+    g = Graph(2 * k)
+    for base in (0, k):
+        for i in range(k):
+            for j in range(i + 1, k):
+                g.add_edge(base + i, base + j)
+    g.add_edge(0, k)  # bridge
+    return g
+
+
+# ---------------------------------------------------------------- structure
+
+def test_graph_structure():
+    g = Graph(4)
+    g.add_edge(0, 1)
+    g.add_edge(1, 2, directed=True)
+    g.add_edge(Edge(2, 3, value=2.5))
+    assert g.num_vertices() == 4
+    assert g.num_edges() == 3
+    # undirected edge appears in both adjacency lists
+    assert 0 in g.get_connected_vertex_indices(1)
+    assert 1 in g.get_connected_vertex_indices(0)
+    # directed edge only forward
+    assert 2 in g.get_connected_vertex_indices(1)
+    assert 1 not in g.get_connected_vertex_indices(2)
+    assert g.get_vertex_degree(1) == 2
+
+
+def test_graph_loader_roundtrip(tmp_path):
+    p = tmp_path / "edges.txt"
+    p.write_text("# comment\n0 1\n1 2 0.5\n2 3\n")
+    g = GraphLoader.load_weighted_edge_list(str(p), 4)
+    assert g.num_edges() == 3
+    edges = {(e.frm, e.to): e.weight() for e in g.get_edges_out(1) if e.frm == 1}
+    assert edges[(1, 2)] == 0.5
+
+
+# ------------------------------------------------------------------- walks
+
+def test_random_walk_properties():
+    g = _two_cluster_graph()
+    it = RandomWalkIterator(g, walk_length=8, seed=7)
+    walks = list(it)
+    assert len(walks) == g.num_vertices()
+    starts = sorted(int(w[0]) for w in walks)
+    assert starts == list(range(g.num_vertices()))  # one walk per vertex
+    for w in walks:
+        assert len(w) == 9
+        for a, b in zip(w[:-1], w[1:]):
+            assert int(b) in g.get_connected_vertex_indices(int(a))
+
+
+def test_disconnected_vertex_handling():
+    g = Graph(3)
+    g.add_edge(0, 1)
+    it = RandomWalkIterator(g, walk_length=4, seed=1,
+                            no_edge_handling=NoEdgeHandling.SELF_LOOP_ON_DISCONNECTED)
+    walks = {int(w[0]): w for w in it}
+    assert all(int(v) == 2 for v in walks[2])  # self-loops in place
+    it2 = RandomWalkIterator(g, walk_length=4, seed=1,
+                             no_edge_handling=NoEdgeHandling.EXCEPTION_ON_DISCONNECTED)
+    with pytest.raises(NoEdgesError):
+        list(it2)
+
+
+def test_weighted_walk_respects_weights():
+    # vertex 0 has a heavy edge to 1 (w=100) and light to 2 (w=1)
+    g = Graph(3)
+    g.add_edge(0, 1, value=100.0)
+    g.add_edge(0, 2, value=1.0)
+    g.add_edge(1, 2, value=1.0)
+    it = WeightedRandomWalkIterator(g, walk_length=1, seed=3)
+    heavy = 0
+    n_trials = 200
+    for trial in range(n_trials):
+        it.seed = trial
+        it.reset()
+        for w in it:
+            if int(w[0]) == 0 and int(w[1]) == 1:
+                heavy += 1
+    assert heavy > 0.85 * n_trials  # ~99% expected
+
+
+# ----------------------------------------------------------------- huffman
+
+def test_graph_huffman_invariants():
+    g = _two_cluster_graph()
+    h = GraphHuffman(g)
+    n = g.num_vertices()
+    codes = [tuple(h.get_code(i)) for i in range(n)]
+    # prefix-free: no code is a prefix of another
+    for i in range(n):
+        for j in range(n):
+            if i != j:
+                ci, cj = codes[i], codes[j]
+                assert not (len(ci) <= len(cj) and cj[:len(ci)] == ci)
+    # higher-degree vertices get codes no longer than lower-degree ones
+    degs = g.degree_vector()
+    hi, lo = int(np.argmax(degs)), int(np.argmin(degs))
+    assert len(codes[hi]) <= len(codes[lo])
+    # points are valid inner-node ids
+    for i in range(n):
+        for p in h.get_path_inner_nodes(i):
+            assert 0 <= p < n - 1
+
+
+# ---------------------------------------------------------------- deepwalk
+
+def test_deepwalk_two_cluster_embedding(tmp_path):
+    g = _two_cluster_graph(k=6)
+    dw = (DeepWalk.builder().vector_size(16).window_size(3)
+          .learning_rate(0.1).seed(42).build())
+    dw.initialize(g)
+    assert dw.vectors.shape == (12, 16)
+    dw.fit(walk_length=8, epochs=50)
+    # same-cluster pairs should be closer than cross-cluster pairs
+    intra = np.mean([dw.similarity(i, j)
+                     for i in range(1, 6) for j in range(i + 1, 6)])
+    inter = np.mean([dw.similarity(i, j)
+                     for i in range(1, 6) for j in range(7, 12)])
+    assert intra > inter + 0.1, (intra, inter)
+    # nearest neighbours of a non-bridge vertex stay in its own cluster
+    near = dw.vertices_nearest(2, top=3)
+    assert sum(1 for v in near if v < 6) >= 2
+    # save/load round trip
+    p = str(tmp_path / "dw.txt")
+    dw.save(p)
+    gv = DeepWalk.load(p)
+    assert isinstance(gv, GraphVectors)
+    np.testing.assert_allclose(gv.vectors, dw.vectors, rtol=1e-4, atol=1e-5)
+
+
+def test_deepwalk_requires_initialize():
+    dw = DeepWalk(vector_size=8)
+    with pytest.raises(RuntimeError):
+        dw.fit()
