@@ -1,0 +1,23 @@
+"""Record readers + record→DataSet iterators (the DataVec bridge).
+
+TPU-native counterpart of the reference's DataVec dependency plus the
+in-repo adapters at deeplearning4j-core/src/main/java/org/deeplearning4j/
+datasets/datavec/{RecordReaderDataSetIterator,
+SequenceRecordReaderDataSetIterator,RecordReaderMultiDataSetIterator}.java.
+Every real-world training workflow in the reference starts here: CSV,
+image-folder, and time-series files become DataSet minibatches that feed the
+existing iterator SPI (and AsyncDataSetIterator for prefetch overlap).
+"""
+from .reader import (RecordReader, CSVRecordReader, CSVSequenceRecordReader,
+                     ImageRecordReader, CollectionRecordReader,
+                     ListStringRecordReader)
+from .iterator import (RecordReaderDataSetIterator,
+                       SequenceRecordReaderDataSetIterator,
+                       RecordReaderMultiDataSetIterator, AlignmentMode)
+
+__all__ = [
+    "RecordReader", "CSVRecordReader", "CSVSequenceRecordReader",
+    "ImageRecordReader", "CollectionRecordReader", "ListStringRecordReader",
+    "RecordReaderDataSetIterator", "SequenceRecordReaderDataSetIterator",
+    "RecordReaderMultiDataSetIterator", "AlignmentMode",
+]

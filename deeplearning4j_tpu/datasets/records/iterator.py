@@ -1,0 +1,254 @@
+"""Record → DataSet/MultiDataSet iterators.
+
+Reference: deeplearning4j-core/src/main/java/org/deeplearning4j/datasets/
+datavec/RecordReaderDataSetIterator.java (label column → one-hot, regression
+ranges, image records), SequenceRecordReaderDataSetIterator.java (two-reader
+and single-reader modes, AlignmentMode padding + masks),
+RecordReaderMultiDataSetIterator.java (named-reader builder).
+
+Sequence layout is (batch, time, features) matching the recurrent layers
+(nn/layers/recurrent.py); masks are float (batch, time).
+"""
+from __future__ import annotations
+
+import numpy as np
+
+from ..dataset import DataSet, MultiDataSet
+from ..iterator.base import DataSetIterator
+from .reader import RecordReader, SequenceRecordReader
+
+
+def _one_hot(idx, n):
+    v = np.zeros(n, np.float32)
+    v[int(idx)] = 1.0
+    return v
+
+
+class RecordReaderDataSetIterator(DataSetIterator):
+    """(reference: RecordReaderDataSetIterator.java). Modes:
+    - classification: label_index + num_possible_labels → one-hot labels
+    - regression: label_index_from..label_index_to (inclusive) as labels
+    - image records ([array, label]): array features + one-hot labels
+    - no label args: whole record is the feature vector (labels = features)
+    """
+
+    def __init__(self, record_reader: RecordReader, batch_size,
+                 label_index=None, num_possible_labels=None,
+                 label_index_from=None, label_index_to=None, regression=False,
+                 preprocessor=None):
+        self.reader = record_reader
+        self.batch_size = int(batch_size)
+        self.label_index = label_index
+        self.num_possible_labels = num_possible_labels
+        self.regression = regression or label_index_from is not None
+        self.label_index_from = label_index_from
+        self.label_index_to = label_index_to
+        self.preprocessor = preprocessor
+
+    # ------------------------------------------------------------ assembly
+    def _split(self, record):
+        if len(record) == 2 and isinstance(record[0], np.ndarray):
+            # image record: [array, label_idx]
+            n = self.num_possible_labels or 0
+            lab = _one_hot(record[1], n) if n else np.float32([record[1]])
+            return record[0], lab
+        vals = record
+        if self.regression:
+            lo = self.label_index_from if self.label_index_from is not None \
+                else self.label_index
+            hi = self.label_index_to if self.label_index_to is not None else lo
+            label = np.asarray([vals[i] for i in range(lo, hi + 1)], np.float32)
+            feats = [v for i, v in enumerate(vals) if not (lo <= i <= hi)]
+            return np.asarray(feats, np.float32), label
+        if self.label_index is not None:
+            li = self.label_index if self.label_index >= 0 \
+                else len(vals) + self.label_index
+            label = _one_hot(vals[li], self.num_possible_labels)
+            feats = [v for i, v in enumerate(vals) if i != li]
+            return np.asarray(feats, np.float32), label
+        f = np.asarray(vals, np.float32)
+        return f, f
+
+    def next(self):
+        feats, labels = [], []
+        while len(feats) < self.batch_size and self.reader.has_next():
+            f, l = self._split(self.reader.next_record())
+            feats.append(f)
+            labels.append(l)
+        ds = DataSet(np.stack(feats), np.stack(labels))
+        if self.preprocessor is not None:
+            ds = self.preprocessor(ds)
+        return ds
+
+    def has_next(self):
+        return self.reader.has_next()
+
+    def reset(self):
+        self.reader.reset()
+
+    def batch(self):
+        return self.batch_size
+
+
+class AlignmentMode:
+    """(reference: SequenceRecordReaderDataSetIterator.AlignmentMode)"""
+    EQUAL_LENGTH = "equal_length"
+    ALIGN_START = "align_start"
+    ALIGN_END = "align_end"
+
+
+class SequenceRecordReaderDataSetIterator(DataSetIterator):
+    """(reference: SequenceRecordReaderDataSetIterator.java).
+
+    Two-reader mode: `features_reader` rows are feature vectors,
+    `labels_reader` rows are labels (one value per step for classification).
+    Single-reader mode: pass only `features_reader` + label_index; the label
+    column is split out of each time step.
+
+    Variable-length sequences are padded to the batch max and masked
+    per AlignmentMode (ALIGN_START pads at the end, ALIGN_END at the start).
+    """
+
+    def __init__(self, features_reader: SequenceRecordReader, batch_size,
+                 num_possible_labels=None, label_index=None,
+                 labels_reader: SequenceRecordReader = None, regression=False,
+                 alignment_mode=AlignmentMode.ALIGN_START):
+        self.features_reader = features_reader
+        self.labels_reader = labels_reader
+        self.batch_size = int(batch_size)
+        self.num_possible_labels = num_possible_labels
+        self.label_index = label_index
+        self.regression = regression
+        self.alignment_mode = alignment_mode
+
+    def _next_sequences(self):
+        f_seq = self.features_reader.next_sequence()
+        if self.labels_reader is not None:
+            l_seq = self.labels_reader.next_sequence()
+            feats = np.asarray(f_seq, np.float32)
+        else:
+            li = self.label_index if self.label_index is not None else -1
+            li = li if li >= 0 else len(f_seq[0]) + li
+            feats = np.asarray(
+                [[v for i, v in enumerate(row) if i != li] for row in f_seq],
+                np.float32)
+            l_seq = [[row[li]] for row in f_seq]
+        if self.regression:
+            labels = np.asarray(l_seq, np.float32)
+        else:
+            labels = np.stack([_one_hot(row[0], self.num_possible_labels)
+                               for row in l_seq])
+        return feats, labels
+
+    def next(self):
+        fs, ls = [], []
+        while len(fs) < self.batch_size and self.features_reader.has_next():
+            f, l = self._next_sequences()
+            fs.append(f)
+            ls.append(l)
+        T = max(f.shape[0] for f in fs)
+        B = len(fs)
+        feats = np.zeros((B, T, fs[0].shape[1]), np.float32)
+        labels = np.zeros((B, max(l.shape[0] for l in ls), ls[0].shape[1]),
+                          np.float32)
+        fmask = np.zeros((B, T), np.float32)
+        lmask = np.zeros((B, labels.shape[1]), np.float32)
+        for i, (f, l) in enumerate(zip(fs, ls)):
+            tf, tl = f.shape[0], l.shape[0]
+            if self.alignment_mode == AlignmentMode.ALIGN_END:
+                feats[i, T - tf:] = f
+                fmask[i, T - tf:] = 1.0
+                labels[i, labels.shape[1] - tl:] = l
+                lmask[i, labels.shape[1] - tl:] = 1.0
+            else:
+                feats[i, :tf] = f
+                fmask[i, :tf] = 1.0
+                labels[i, :tl] = l
+                lmask[i, :tl] = 1.0
+        if fmask.all() and lmask.all():
+            return DataSet(feats, labels)
+        return DataSet(feats, labels, fmask, lmask)
+
+    def has_next(self):
+        return self.features_reader.has_next()
+
+    def reset(self):
+        self.features_reader.reset()
+        if self.labels_reader is not None:
+            self.labels_reader.reset()
+
+    def batch(self):
+        return self.batch_size
+
+
+class RecordReaderMultiDataSetIterator(DataSetIterator):
+    """Named-reader builder → MultiDataSet for ComputationGraph
+    (reference: RecordReaderMultiDataSetIterator.java Builder —
+    addReader/addInput/addOutput/addOutputOneHot)."""
+
+    class Builder:
+        def __init__(self, batch_size):
+            self.batch_size = int(batch_size)
+            self.readers = {}
+            self.inputs = []   # (reader_name, col_from, col_to)
+            self.outputs = []  # (reader_name, col_from, col_to, one_hot_n)
+
+        def add_reader(self, name, reader):
+            self.readers[name] = reader
+            return self
+
+        def add_input(self, name, col_from=None, col_to=None):
+            self.inputs.append((name, col_from, col_to))
+            return self
+
+        def add_output(self, name, col_from=None, col_to=None):
+            self.outputs.append((name, col_from, col_to, None))
+            return self
+
+        def add_output_one_hot(self, name, column, num_classes):
+            self.outputs.append((name, column, column, int(num_classes)))
+            return self
+
+        def build(self):
+            return RecordReaderMultiDataSetIterator(self)
+
+    def __init__(self, builder):
+        self._b = builder
+
+    def _collect(self, records, spec):
+        name, c_from, c_to, *rest = spec + (None,) * (4 - len(spec))
+        one_hot = rest[0] if rest else None
+        rec = records[name]
+        if c_from is None:
+            vals = rec
+        else:
+            hi = c_to if c_to is not None else c_from
+            vals = rec[c_from:hi + 1]
+        if one_hot:
+            return _one_hot(vals[0], one_hot)
+        return np.asarray(vals, np.float32)
+
+    def next(self):
+        b = self._b
+        ins = [[] for _ in b.inputs]
+        outs = [[] for _ in b.outputs]
+        n = 0
+        while n < b.batch_size and self.has_next():
+            records = {name: r.next_record() for name, r in b.readers.items()}
+            for i, spec in enumerate(b.inputs):
+                ins[i].append(self._collect(records, tuple(spec)))
+            for i, spec in enumerate(b.outputs):
+                outs[i].append(self._collect(records, tuple(spec)))
+            n += 1
+        return MultiDataSet([np.stack(a) for a in ins],
+                            [np.stack(a) for a in outs])
+
+    def has_next(self):
+        return all(r.has_next() for r in self._b.readers.values())
+
+    def reset(self):
+        for r in self._b.readers.values():
+            r.reset()
+
+    def batch(self):
+        return self._b.batch_size
