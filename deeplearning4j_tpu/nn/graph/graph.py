@@ -245,22 +245,30 @@ class ComputationGraph:
         return out
 
     # ---------------------------------------------------------------- train
-    def _make_train_step(self):
+    def _make_train_step(self, tbptt=False):
         tx = self._tx
 
         def train_step(params, opt_state, states, rng, inputs, labels, masks,
-                       label_masks):
+                       label_masks, carries):
             def loss_fn(p):
                 return self._loss(p, states, inputs, labels, train=True, rng=rng,
-                                  masks=masks, label_masks=label_masks)
-            (score, (new_states, _)), grads = jax.value_and_grad(
+                                  masks=masks, label_masks=label_masks,
+                                  initial_carries=carries if tbptt else None)
+            (score, (new_states, out_carries)), grads = jax.value_and_grad(
                 loss_fn, has_aux=True)(params)
             grads = self._normalize_grads(grads)
             updates, opt_state = tx.update(grads, opt_state, params)
             params = optax.apply_updates(params, updates)
-            return params, opt_state, new_states, score
+            return params, opt_state, new_states, score, out_carries
 
         return jax.jit(train_step, donate_argnums=(0, 1, 2))
+
+    def _get_train_step(self, key="std"):
+        """One cached jitted step per mode; jit itself retraces per input
+        structure (mask presence etc.), so no structure-derived keys needed."""
+        if key not in self._jit_cache:
+            self._jit_cache[key] = self._make_train_step(tbptt=(key == "tbptt"))
+        return self._jit_cache[key]
 
     def fit(self, data, labels=None, epochs=1):
         """Accepts MultiDataSet / DataSet / iterator thereof / (x, y)
@@ -278,10 +286,14 @@ class ComputationGraph:
         else:
             items = as_iterator(data)
         for _ in range(epochs):
+            for listener in self.listeners:
+                listener.on_epoch_start(self)
             if hasattr(items, "reset"):
                 items.reset()
             for ds in items:
                 self.fit_batch(ds)
+            for listener in self.listeners:
+                listener.on_epoch_end(self)
             self.epoch_count += 1
         return self
 
@@ -309,21 +321,58 @@ class ComputationGraph:
                     self.conf.optimization_algo, self,
                     line_search_iterations=self.conf.max_num_line_search_iterations)
             self._flat_solver.optimize(inputs, labels, masks, lmasks)
-            self.iteration_count += 1
-            for listener in self.listeners:
-                listener.iteration_done(self, self.iteration_count)
-            return
-        key = ("train", masks is None, lmasks is None)
-        if key not in self._jit_cache:
-            self._jit_cache[key] = self._make_train_step()
-        step = self._jit_cache[key]
-        self.params, self.opt_state, self.states, score = step(
-            self.params, self.opt_state, self.states, step_rng, inputs, labels,
-            masks, lmasks)
-        self.score_value = score  # device scalar; syncs lazily on read
+        else:
+            T = max((x.shape[1] for x in inputs
+                     if hasattr(x, "ndim") and x.ndim == 3), default=0)
+            if (self.conf.backprop_type == BackpropType.TRUNCATED_BPTT
+                    and T > self.conf.tbptt_fwd_length):
+                self._fit_tbptt(inputs, labels, masks, lmasks, step_rng, T)
+            else:
+                step = self._get_train_step("std")
+                self.params, self.opt_state, self.states, score, _ = step(
+                    self.params, self.opt_state, self.states, step_rng, inputs,
+                    labels, masks, lmasks, None)
+                self.score_value = score  # device scalar; syncs lazily on read
         self.iteration_count += 1
         for listener in self.listeners:
+            if hasattr(listener, "record_batch_size"):
+                listener.record_batch_size(inputs[0].shape[0])
             listener.iteration_done(self, self.iteration_count)
+
+    def _fit_tbptt(self, inputs, labels, masks, lmasks, rng, T):
+        """Truncated BPTT over the graph (reference: ComputationGraph TBPTT via
+        doTruncatedBPTT in ComputationGraph.java): slide a tbptt_fwd_length
+        window over every time-distributed (3D) input/label, carrying recurrent
+        layer state (stop-gradient) across windows; non-temporal inputs are
+        passed whole to every window."""
+        L = self.conf.tbptt_fwd_length
+        batch = inputs[0].shape[0]
+        carries = self._zero_carries(batch)
+        step = self._get_train_step("tbptt")
+        scores = []
+        for start in range(0, T, L):
+            end = min(start + L, T)
+            xw = [x[:, start:end] if x.ndim == 3 and x.shape[1] == T else x
+                  for x in inputs]
+            yw = [y[:, start:end] if y.ndim == 3 and y.shape[1] == T else y
+                  for y in labels]
+            mw = None if masks is None else \
+                [None if m is None else
+                 (m[:, start:end] if m.ndim >= 2 and m.shape[1] == T else m)
+                 for m in masks]
+            lmw = None if lmasks is None else \
+                [None if m is None else
+                 (m[:, start:end] if m.ndim >= 2 and m.shape[1] == T else m)
+                 for m in lmasks]
+            rng, sub = jax.random.split(rng)
+            # gradient truncation at window edges is inherent: each window's
+            # value_and_grad differentiates params only; carries enter the next
+            # step as concrete (non-differentiated) arguments
+            self.params, self.opt_state, self.states, score, carries = step(
+                self.params, self.opt_state, self.states, sub, xw, yw, mw, lmw,
+                carries)
+            scores.append(score)
+        self.score_value = jnp.mean(jnp.stack(scores))
 
     # ------------------------------------------------------------ inference
     def output(self, *inputs, train=False):

@@ -326,10 +326,12 @@ class MultiLayerNetwork:
             mw = mask[:, start:end] if mask is not None else None
             lmw = lmask[:, start:end] if lmask is not None else None
             rng, sub = jax.random.split(rng)
+            # gradient truncation at window edges is inherent: each window's
+            # value_and_grad differentiates params only; carries enter the next
+            # step as concrete (non-differentiated) arguments
             (self.params, self.opt_state, self.states, score, carries,
              self.last_gradients) = step(
                 self.params, self.opt_state, self.states, sub, xw, yw, mw, lmw, carries)
-            carries = jax.tree_util.tree_map(jax.lax.stop_gradient, carries)
             scores.append(score)
         # mean stays on device; syncs lazily when score_value is read
         self.score_value = jnp.mean(jnp.stack(scores))

@@ -127,7 +127,7 @@ class ParameterAveragingTrainingMaster(TrainingMaster):
 
         from ..nn.multilayer.network import MultiLayerNetwork
         is_mln = isinstance(model, MultiLayerNetwork)
-        step = model._get_train_step("std") if is_mln else model._make_train_step()
+        step = model._get_train_step("std")
 
         # replicate: stack params/opt_state/states on a leading replica axis
         stack = lambda t: jax.tree_util.tree_map(
@@ -139,21 +139,24 @@ class ParameterAveragingTrainingMaster(TrainingMaster):
 
         def run_step(params, opt_state, states, rngs, x, y, mask, lmask):
             """vmap the per-replica step, adapting the MLN (9-arg, 5-result)
-            vs ComputationGraph (8-arg, 4-result) train-step signatures and
-            passing masks through (in_axes None when absent)."""
-            in_axes = (0, 0, 0, 0, 0, 0,
-                       None if mask is None else 0,
-                       None if lmask is None else 0)
+            vs ComputationGraph (9-arg, 5-result, list-valued data) train-step
+            signatures. `x`/`y` (and masks) are lists for the CG path; None
+            leaves (absent masks) are empty pytrees so in_axes=0 skips them."""
             if is_mln:
+                if len(x) != 1 or len(y) != 1:
+                    raise ValueError(
+                        "MultiLayerNetwork is single-input/single-output; got "
+                        f"{len(x)} inputs / {len(y)} labels — use a "
+                        "ComputationGraph for MultiDataSet training")
                 fn = lambda p, o, s, r, xx, yy, m, lm: \
-                    step(p, o, s, r, xx, yy, m, lm, None)[:4]
+                    step(p, o, s, r, xx[0], yy[0], m[0], lm[0], None)[:4]
             else:
                 fn = lambda p, o, s, r, xx, yy, m, lm: \
-                    step(p, o, s, r, [xx], [yy],
-                         None if m is None else [m],
-                         None if lm is None else [lm])
-            return jax.vmap(fn, in_axes=in_axes)(
-                params, opt_state, states, rngs, x, y, mask, lmask)
+                    step(p, o, s, r, xx, yy,
+                         None if all(e is None for e in m) else m,
+                         None if all(e is None for e in lm) else lm,
+                         None)[:4]
+            return jax.vmap(fn)(params, opt_state, states, rngs, x, y, mask, lmask)
 
         from ..datasets.iterator.base import as_iterator
         it = as_iterator(data_iterator)
@@ -163,30 +166,40 @@ class ParameterAveragingTrainingMaster(TrainingMaster):
         score = float("nan")
 
         def push(ds):
-            if isinstance(ds.features, list) and len(ds.features) > 1:
-                raise NotImplementedError(
-                    "averaging mode supports single-input/single-output "
-                    "models; use mode='allreduce' for multi-input graphs")
-            feats = ds.features[0] if isinstance(ds.features, list) else ds.features
-            labels = ds.labels[0] if isinstance(ds.labels, list) else ds.labels
-            fm = getattr(ds, "features_mask", None)
-            lm = getattr(ds, "labels_mask", None)
-            bufs["x"].append(np.asarray(feats))
-            bufs["y"].append(np.asarray(labels))
-            bufs["m"].append(None if fm is None else np.asarray(fm))
-            bufs["lm"].append(None if lm is None else np.asarray(lm))
+            feats = ds.features if isinstance(ds.features, list) else [ds.features]
+            labels = ds.labels if isinstance(ds.labels, list) else [ds.labels]
+            fms = getattr(ds, "features_masks", None)
+            lms = getattr(ds, "labels_masks", None)
+            if fms is None:
+                fm = getattr(ds, "features_mask", None)
+                fms = [fm] * len(feats)
+            if lms is None:
+                lm = getattr(ds, "labels_mask", None)
+                lms = [lm] * len(labels)
+            bufs["x"].append([np.asarray(f) for f in feats])
+            bufs["y"].append([np.asarray(l) for l in labels])
+            bufs["m"].append([None if m is None else np.asarray(m) for m in fms])
+            bufs["lm"].append([None if m is None else np.asarray(m) for m in lms])
 
         def stack_buf(key, dtype=None):
+            """Stack the window's batches position-wise: bufs[key] is a list
+            (window) of lists (input position); returns a list with one
+            replica-stacked array (or None) per position."""
             vals = bufs[key]
-            if all(v is None for v in vals):
-                return None
-            if any(v is None for v in vals):
-                raise ValueError(
-                    "averaging window mixes masked and unmasked batches — "
-                    "masks must be consistently present or absent")
-            min_b = min(v.shape[0] for v in vals)  # ragged final batch guard
-            arr = np.stack([v[:min_b] for v in vals])
-            return jnp.asarray(arr) if dtype is None else jnp.asarray(arr, dtype)
+            out = []
+            for j in range(len(vals[0])):
+                col = [v[j] for v in vals]
+                if all(c is None for c in col):
+                    out.append(None)
+                    continue
+                if any(c is None for c in col):
+                    raise ValueError(
+                        "averaging window mixes masked and unmasked batches — "
+                        "masks must be consistently present or absent")
+                min_b = min(c.shape[0] for c in col)  # ragged final batch guard
+                arr = np.stack([c[:min_b] for c in col])
+                out.append(jnp.asarray(arr) if dtype is None else jnp.asarray(arr, dtype))
+            return out
 
         # partial final window: cycle the already-buffered batches so every
         # replica still trains on real data (the reference re-partitions the

@@ -217,3 +217,54 @@ def test_graph_gradient_check():
             denom = abs(numeric) + abs(gf[i])
             rel = abs(numeric - gf[i]) / denom if denom else 0.0
             assert rel < 1e-3 or abs(numeric - gf[i]) < 1e-8
+
+
+def test_graph_tbptt_and_epoch_listeners():
+    """TBPTT on a ComputationGraph carries LSTM state across windows and the
+    fit() loop fires epoch listener hooks (reference: ComputationGraph.java
+    TBPTT fit path + MLN listener parity)."""
+    from deeplearning4j_tpu.nn.conf.configuration import BackpropType
+    from deeplearning4j_tpu.optimize.listeners import IterationListener
+
+    rng = np.random.default_rng(3)
+    T, B, nin, nout = 12, 8, 5, 3
+    X = rng.normal(size=(B, T, nin)).astype(np.float32)
+    Y = np.eye(nout, dtype=np.float32)[rng.integers(0, nout, (B, T))]
+
+    conf = (NeuralNetConfiguration.builder()
+            .seed(7).updater(Adam(5e-3))
+            .graph_builder()
+            .add_inputs("in")
+            .add_layer("lstm", GravesLSTM(n_out=8, activation="tanh"), "in")
+            .add_layer("out", RnnOutputLayer(n_out=nout, activation="softmax",
+                                             loss="MCXENT"), "lstm")
+            .set_outputs("out")
+            .set_input_types(InputType.recurrent(nin))
+            .backprop_type(BackpropType.TRUNCATED_BPTT)
+            .tbptt_fwd_length(4)
+            .build())
+    g = ComputationGraph(conf).init()
+
+    class Hooks(IterationListener):
+        def __init__(self):
+            self.starts = self.ends = self.iters = 0
+
+        def on_epoch_start(self, model):
+            self.starts += 1
+
+        def on_epoch_end(self, model):
+            self.ends += 1
+
+        def iteration_done(self, model, iteration):
+            self.iters += 1
+
+    h = Hooks()
+    g.set_listeners(h)
+    s0 = g.score(MultiDataSet([X], [Y]))
+    g.fit([MultiDataSet([X], [Y])], epochs=25)
+    assert h.starts == 25 and h.ends == 25 and h.iters == 25
+    assert np.isfinite(g.score_value)
+    assert g.score(MultiDataSet([X], [Y])) < s0
+    # stateful streaming inference still works after TBPTT training
+    out = g.rnn_time_step(X[:, 0])
+    assert out.shape == (B, nout)

@@ -299,3 +299,35 @@ def test_early_stopping_parallel_trainer():
     result = trainer.fit()
     assert result.total_epochs == 3
     assert result.get_best_model() is not None
+
+
+def test_training_master_averaging_multi_input_graph():
+    """Averaging mode on a multi-input/multi-output ComputationGraph
+    (previously NotImplementedError; reference ParameterAveragingTrainingMaster
+    handles MultiDataSet via SparkComputationGraph)."""
+    from deeplearning4j_tpu import (ComputationGraph, MergeVertex, MultiDataSet)
+    rng = np.random.default_rng(5)
+    Xa = rng.normal(size=(64, 4)).astype(np.float32)
+    Xb = rng.normal(size=(64, 3)).astype(np.float32)
+    w = rng.normal(size=(7, 2))
+    Y = np.eye(2, dtype=np.float32)[np.argmax(np.concatenate([Xa, Xb], 1) @ w, axis=1)]
+    conf = (NeuralNetConfiguration.builder()
+            .seed(11).updater(Adam(1e-2))
+            .graph_builder()
+            .add_inputs("a", "b")
+            .add_vertex("merged", MergeVertex(), "a", "b")
+            .add_layer("d", DenseLayer(n_out=16, activation="relu"), "merged")
+            .add_layer("out", OutputLayer(n_out=2, activation="softmax",
+                                          loss="MCXENT"), "d")
+            .set_outputs("out")
+            .set_input_types(InputType.feed_forward(4), InputType.feed_forward(3))
+            .build())
+    g = ComputationGraph(conf).init()
+    s0 = g.score(MultiDataSet([Xa, Xb], [Y]))
+    data = [MultiDataSet([Xa[i:i + 16], Xb[i:i + 16]], [Y[i:i + 16]])
+            for i in range(0, 64, 16)]
+    tm = (ParameterAveragingTrainingMaster.builder(16)
+          .worker_count(4).averaging_frequency(1).mode("averaging").build())
+    for _ in range(20):
+        tm.execute_training(g, data)
+    assert g.score(MultiDataSet([Xa, Xb], [Y])) < s0 * 0.7
