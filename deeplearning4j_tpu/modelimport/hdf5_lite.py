@@ -12,14 +12,22 @@ format subset that Keras 1.x files use:
   string types (what Keras writes: model_config JSON, layer_names,
   weight_names, keras_version)
 
-The writer emits the same subset (spec-compliant, h5py-readable) and exists
-mainly to build test fixtures and to export models in Keras-compatible form.
-Unsupported features (chunked+filtered data, v2 headers, variable-length
-strings) raise clear errors.
+The reader additionally understands what real h5py/Keras files contain:
+
+- chunked datasets (layout v3 class 2) indexed by a v1 chunk B-tree
+- filter pipeline (v1+v2 messages): gzip/deflate, shuffle, fletcher32
+- variable-length string attributes (global-heap backed; h5py 3 stores
+  Python `str` attributes this way)
+
+The writer emits the contiguous subset (spec-compliant, h5py-readable) and
+exists mainly to build test fixtures and to export models in Keras-compatible
+form. Remaining unsupported features (v2 object headers, vlen dataset
+elements) raise clear errors.
 """
 from __future__ import annotations
 
 import struct
+import zlib
 
 import numpy as np
 
@@ -251,6 +259,15 @@ def _write_group(w, group):
 # reader
 # =====================================================================
 
+class _VlenStr:
+    """Datatype sentinel: variable-length string (global-heap backed)."""
+
+    def __init__(self, utf8=True):
+        self.utf8 = utf8
+    kind = "vlen"
+    itemsize = 16  # (length:4, gheap collection addr:8, object index:4)
+
+
 class H5Object:
     """A parsed group or dataset."""
 
@@ -263,6 +280,9 @@ class H5Object:
         self._dtype = None
         self._data_addr = None
         self._data_size = None
+        self._chunk_btree = None
+        self._chunk_dims = None
+        self._filters = []      # [(filter_id, client_values), ...] in order
         reader._parse_object(self)
 
     # ---- group-like -------------------------------------------------------
@@ -286,7 +306,7 @@ class H5Object:
     # ---- dataset-like -----------------------------------------------------
     @property
     def is_dataset(self):
-        return self._data_addr is not None
+        return self._data_addr is not None or self._chunk_btree is not None
 
     def __array__(self):
         return self.value
@@ -295,9 +315,61 @@ class H5Object:
     def value(self):
         if not self.is_dataset:
             raise ValueError("not a dataset")
+        if isinstance(self._dtype, _VlenStr):
+            raise NotImplementedError("variable-length dataset elements "
+                                      "unsupported (attributes only)")
+        if self._chunk_btree is not None:
+            return self._read_chunked()
         raw = self._r.data[self._data_addr:self._data_addr + self._data_size]
         arr = np.frombuffer(raw, dtype=self._dtype)
         return arr.reshape(self._shape)
+
+    def _read_chunked(self):
+        """Assemble a chunked dataset: walk the chunk B-tree, undo the filter
+        pipeline per chunk, and scatter chunks into the output (edge chunks
+        are stored full-size and cropped)."""
+        shape = self._shape
+        cdims = self._chunk_dims        # per-dim chunk shape (no element dim)
+        out = np.zeros(shape, dtype=self._dtype)
+        itemsize = self._dtype.itemsize
+        chunk_elems = int(np.prod(cdims))
+        for offsets, filter_mask, addr, nbytes in \
+                self._r._walk_chunk_btree(self._chunk_btree, len(cdims)):
+            raw = self._r.data[addr:addr + nbytes]
+            raw = _defilter(raw, self._filters, filter_mask, itemsize)
+            if len(raw) < chunk_elems * itemsize:
+                raise ValueError("chunk shorter than expected after filters")
+            chunk = np.frombuffer(raw, dtype=self._dtype,
+                                  count=chunk_elems).reshape(cdims)
+            sel = tuple(slice(o, min(o + c, s))
+                        for o, c, s in zip(offsets, cdims, shape))
+            crop = tuple(slice(0, s.stop - s.start) for s in sel)
+            out[sel] = chunk[crop]
+        return out
+
+
+def _defilter(raw, filters, filter_mask, itemsize):
+    """Undo the filter pipeline (applied in reverse order on read). Filters:
+    1=deflate, 2=shuffle, 3=fletcher32. filter_mask bit i set = filter i was
+    skipped for this chunk."""
+    for i in reversed(range(len(filters))):
+        if filter_mask & (1 << i):
+            continue
+        fid, cvals = filters[i]
+        if fid == 1:      # gzip/deflate
+            raw = zlib.decompress(raw)
+        elif fid == 2:    # shuffle: de-interleave bytes back into elements
+            size = cvals[0] if cvals else itemsize
+            n = len(raw) // size
+            if n * size == len(raw) and size > 1:
+                raw = np.frombuffer(raw, np.uint8).reshape(
+                    size, n).T.tobytes()
+        elif fid == 3:    # fletcher32: trailing 4-byte checksum
+            raw = raw[:-4]
+        else:
+            raise NotImplementedError(f"filter id {fid} unsupported "
+                                      "(gzip/shuffle/fletcher32 only)")
+    return raw
 
 
 class H5Reader:
@@ -360,12 +432,43 @@ class H5Reader:
                 elif cls == 0:  # compact
                     sz, = struct.unpack_from("<H", d, pos + 2)
                     obj._data_addr, obj._data_size = pos + 4, sz
+                elif cls == 2:  # chunked: btree addr + (ndim+1) 4-byte dims,
+                    #             last dim = element size in bytes
+                    ndim_p1 = d[pos + 2]
+                    btree, = struct.unpack_from("<Q", d, pos + 3)
+                    dims = struct.unpack_from(f"<{ndim_p1}I", d, pos + 11)
+                    if btree != UNDEF:
+                        obj._chunk_btree = btree
+                    obj._chunk_dims = tuple(dims[:-1])
                 else:
-                    raise NotImplementedError("chunked datasets unsupported")
+                    raise NotImplementedError(f"layout class {cls} unsupported")
             else:
                 raise NotImplementedError(f"layout v{version} unsupported")
+        elif mtype == 0x000B:  # filter pipeline
+            obj._filters = self._parse_filters(pos)
         elif mtype == 0x000C:  # attribute
             self._parse_attribute(obj, pos)
+
+    def _parse_filters(self, pos):
+        d = self.data
+        version, nfilters = d[pos], d[pos + 1]
+        p = pos + (8 if version == 1 else 2)
+        filters = []
+        for _ in range(nfilters):
+            fid, name_len = struct.unpack_from("<HH", d, p)
+            if version == 2 and fid < 256:
+                name_len = 0
+            _flags, n_cvals = struct.unpack_from("<HH", d, p + 4)
+            p += 8
+            if name_len:
+                pad = _pad8(name_len) if version == 1 else 0
+                p += name_len + pad
+            cvals = struct.unpack_from(f"<{n_cvals}I", d, p)
+            p += 4 * n_cvals
+            if version == 1 and n_cvals % 2:
+                p += 4  # v1 pads odd client-value counts
+            filters.append((fid, cvals))
+        return filters
 
     def _parse_dataspace(self, pos):
         d = self.data
@@ -395,25 +498,87 @@ class H5Reader:
             return np.dtype(f"{'>' if be else '<'}f{size}")
         if cls == 3:   # string
             return np.dtype(f"S{size}")
-        if cls == 9:
+        if cls == 9:   # variable-length
+            vtype = bits[0] & 0x0F
+            if vtype == 1:  # vlen string (h5py stores str attrs this way)
+                return _VlenStr(utf8=bool((bits[0] >> 4) & 0x0F))
             raise NotImplementedError(
-                "variable-length types unsupported (use fixed-size strings)")
+                "variable-length sequence types unsupported")
         raise NotImplementedError(f"datatype class {cls}")
+
+    # ---- global heap (vlen string storage) ---------------------------------
+    def _gheap_object(self, collection_addr, index):
+        """Fetch object `index` from the GCOL global-heap collection."""
+        d = self.data
+        if d[collection_addr:collection_addr + 4] != b"GCOL":
+            raise ValueError("bad global heap collection")
+        size, = struct.unpack_from("<Q", d, collection_addr + 8)
+        p = collection_addr + 16
+        end = collection_addr + size
+        while p < end:
+            obj_idx, _refcnt = struct.unpack_from("<HH", d, p)
+            obj_size, = struct.unpack_from("<Q", d, p + 8)
+            if obj_idx == index:
+                return d[p + 16:p + 16 + obj_size]
+            if obj_idx == 0:  # free space marker terminates the collection
+                break
+            p += 16 + obj_size + _pad8(obj_size)
+        raise KeyError(f"global heap object {index} not found")
+
+    def _read_vlen_strings(self, pos, count, utf8=True):
+        out = []
+        for i in range(count):
+            p = pos + 16 * i
+            _length, addr, idx = struct.unpack_from("<IQI", self.data, p)
+            raw = self._gheap_object(addr, idx)
+            out.append(raw.decode("utf-8" if utf8 else "ascii", "replace"))
+        return out
+
+    # ---- chunk B-tree (node type 1) ----------------------------------------
+    def _walk_chunk_btree(self, addr, ndim):
+        """Yield (offsets, filter_mask, chunk_addr, chunk_nbytes) for every
+        stored chunk. Keys carry ndim+1 offsets (last is the element dim)."""
+        d = self.data
+        key_size = 8 + 8 * (ndim + 1)
+        if d[addr:addr + 4] != b"TREE":
+            raise ValueError("bad chunk B-tree node")
+        node_type, level = d[addr + 4], d[addr + 5]
+        n, = struct.unpack_from("<H", d, addr + 6)
+        if node_type != 1:
+            raise ValueError(f"expected chunk B-tree (type 1), got {node_type}")
+        p = addr + 24
+        for _ in range(n):
+            nbytes, fmask = struct.unpack_from("<II", d, p)
+            offsets = struct.unpack_from(f"<{ndim}Q", d, p + 8)
+            child, = struct.unpack_from("<Q", d, p + key_size)
+            if level > 0:
+                yield from self._walk_chunk_btree(child, ndim)
+            else:
+                yield offsets, fmask, child, nbytes
+            p += key_size + 8
 
     def _parse_attribute(self, obj, pos):
         d = self.data
         version = d[pos]
-        if version != 1:
+        if version not in (1, 2, 3):
             raise NotImplementedError(f"attribute v{version}")
+        flags = 0 if version == 1 else d[pos + 1]
+        if flags & 0x01:
+            raise NotImplementedError("shared attribute datatypes unsupported")
         name_size, dt_size, ds_size = struct.unpack_from("<HHH", d, pos + 2)
-        p = pos + 8
-        name = d[p:p + name_size].split(b"\x00")[0].decode()
-        p += name_size + _pad8(name_size)
+        p = pos + (9 if version == 3 else 8)  # v3 adds a name-charset byte
+        pad = _pad8 if version == 1 else (lambda n: 0)  # v2/v3: no padding
+        name = d[p:p + name_size].split(b"\x00")[0].decode("utf-8", "replace")
+        p += name_size + pad(name_size)
         dtype = self._parse_datatype(p)
-        p += dt_size + _pad8(dt_size)
+        p += dt_size + pad(dt_size)
         shape = self._parse_dataspace(p)
-        p += ds_size + _pad8(ds_size)
+        p += ds_size + pad(ds_size)
         count = int(np.prod(shape)) if shape else 1
+        if isinstance(dtype, _VlenStr):
+            vals = self._read_vlen_strings(p, count, dtype.utf8)
+            obj.attrs[name] = vals[0] if shape == () else vals
+            return
         arr = np.frombuffer(d, dtype=dtype, count=count, offset=p)
         arr = arr.reshape(shape)
         if dtype.kind == "S":
