@@ -29,7 +29,8 @@ from .util.model_serializer import ModelSerializer, ModelGuesser
 from .datasets.dataset import DataSet, MultiDataSet
 from .datasets.iterator.base import (DataSetIterator, ListDataSetIterator,
                                      INDArrayDataSetIterator, AsyncDataSetIterator,
-                                     MultipleEpochsIterator, ExistingDataSetIterator)
+                                     MultipleEpochsIterator, ExistingDataSetIterator,
+                                     DevicePrefetchIterator)
 from .eval.evaluation import Evaluation
 from .eval.roc import ROC, ROCMultiClass, RegressionEvaluation
 from .optimize.listeners import (ScoreIterationListener, PerformanceListener,

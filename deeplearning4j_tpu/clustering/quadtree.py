@@ -1,0 +1,121 @@
+"""QuadTree: 2-D spatial subdivision with center-of-mass aggregation.
+
+Reference: deeplearning4j-core/.../clustering/quadtree/QuadTree.java (+
+Cell.java) — the 2-D special case behind Barnes-Hut t-SNE; the general-D
+sibling is clustering/sptree.py. Kept as its own class for reference parity:
+boundary Cell, northWest/../southEast children, insert with duplicate
+collapsing, subdivide, and the Barnes-Hut force accumulation entry
+(computeNonEdgeForces with the theta criterion).
+"""
+from __future__ import annotations
+
+import numpy as np
+
+
+class Cell:
+    """Axis-aligned square cell (reference: quadtree/Cell.java)."""
+
+    def __init__(self, x, y, hw, hh):
+        self.x, self.y, self.hw, self.hh = float(x), float(y), float(hw), float(hh)
+
+    def contains(self, px, py):
+        return (self.x - self.hw <= px <= self.x + self.hw
+                and self.y - self.hh <= py <= self.y + self.hh)
+
+
+class QuadTree:
+    QT_NODE_CAPACITY = 1  # one point per leaf, like the reference
+
+    def __init__(self, data=None, cell=None):
+        self.cell = cell
+        self.center_of_mass = np.zeros(2)
+        self.cum_size = 0
+        self.size = 0
+        self.point = None
+        self.north_west = self.north_east = None
+        self.south_west = self.south_east = None
+        if data is not None:
+            data = np.asarray(data, np.float64)
+            if self.cell is None:
+                mins, maxs = data.min(0), data.max(0)
+                c = (mins + maxs) / 2
+                half = (maxs - mins) / 2 + 1e-5
+                self.cell = Cell(c[0], c[1], half[0], half[1])
+            for p in data:
+                self.insert(p)
+
+    def is_leaf(self):
+        return self.north_west is None
+
+    def subdivide(self):
+        c = self.cell
+        hw, hh = c.hw / 2, c.hh / 2
+        self.north_west = QuadTree(cell=Cell(c.x - hw, c.y + hh, hw, hh))
+        self.north_east = QuadTree(cell=Cell(c.x + hw, c.y + hh, hw, hh))
+        self.south_west = QuadTree(cell=Cell(c.x - hw, c.y - hh, hw, hh))
+        self.south_east = QuadTree(cell=Cell(c.x + hw, c.y - hh, hw, hh))
+
+    def _children(self):
+        return (self.north_west, self.north_east, self.south_west,
+                self.south_east)
+
+    def insert(self, p):
+        p = np.asarray(p, np.float64)
+        if not self.cell.contains(p[0], p[1]):
+            return False
+        self.cum_size += 1
+        self.center_of_mass += (p - self.center_of_mass) / self.cum_size
+        if self.is_leaf():
+            if self.point is None:
+                self.point = p.copy()
+                self.size = 1
+                return True
+            if np.allclose(self.point, p):  # duplicate point collapses
+                self.size += 1
+                return True
+            self.subdivide()
+            old, self.point, self.size = self.point, None, 0
+            for ch in self._children():
+                if ch.insert(old):
+                    break
+        for ch in self._children():
+            if ch.insert(p):
+                return True
+        return False  # numerically on a boundary sliver; counted in mass
+
+    def depth(self):
+        if self.is_leaf():
+            return 1
+        return 1 + max(ch.depth() for ch in self._children()
+                       if ch.cum_size > 0)
+
+    def compute_non_edge_forces(self, point, theta=0.5):
+        """Barnes-Hut negative-force accumulation for one point: returns
+        (neg_force[2], sum_q) using the theta * (cell_size / dist) criterion
+        (reference: QuadTree.computeNonEdgeForces)."""
+        point = np.asarray(point, np.float64)
+        neg = np.zeros(2)
+        sum_q = 0.0
+        stack = [self]
+        while stack:
+            node = stack.pop()
+            if node.cum_size == 0:
+                continue
+            diff = point - node.center_of_mass
+            dist2 = float(diff @ diff)
+            max_width = max(node.cell.hw, node.cell.hh) * 2
+            if node.is_leaf() or max_width * max_width < theta * theta * dist2:
+                if node.is_leaf() and node.point is not None and \
+                        np.allclose(node.point, point):
+                    n_dup = node.size - 1  # exclude the query point itself
+                    if n_dup <= 0:
+                        continue
+                    mult = n_dup
+                else:
+                    mult = node.cum_size
+                q = 1.0 / (1.0 + dist2)
+                sum_q += mult * q
+                neg += mult * q * q * diff
+            else:
+                stack.extend(ch for ch in node._children())
+        return neg, sum_q

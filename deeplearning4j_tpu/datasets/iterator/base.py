@@ -296,6 +296,111 @@ class AsyncDataSetIterator(DataSetIterator):
         self._start()
 
 
+class DevicePrefetchIterator(DataSetIterator):
+    """Stages upcoming batches into device HBM from a background thread so the
+    host→device DMA of batch N+1 overlaps the device compute of batch N.
+
+    TPU-native double-buffered infeed: the reference pins its prefetch thread
+    to the consumer's device (AsyncDataSetIterator.java:75-76,
+    Nd4j.getAffinityManager) — here `jax.device_put` is issued ahead of
+    consumption on a worker thread, so by the time `fit_batch` traces the
+    arrays they are already on (or in flight to) the chip. Combine with uint8
+    features + ImageScalerPreProcessor to cut the wire bytes 4×."""
+
+    _SENTINEL = object()
+
+    def __init__(self, underlying, queue_size=2, device=None):
+        self.underlying = underlying
+        self.queue_size = int(queue_size)
+        self.device = device
+        self._start()
+
+    def _put(self, ds):
+        import jax
+        dev = self.device
+        put = lambda a: None if a is None else jax.device_put(a, dev)
+        if hasattr(ds, "features_masks"):  # MultiDataSet
+            from ..dataset import MultiDataSet
+            return MultiDataSet([put(f) for f in ds.features],
+                                [put(l) for l in ds.labels],
+                                None if ds.features_masks is None else
+                                [put(m) for m in ds.features_masks],
+                                None if ds.labels_masks is None else
+                                [put(m) for m in ds.labels_masks])
+        return DataSet(put(ds.features), put(ds.labels),
+                       put(ds.features_mask), put(ds.labels_mask))
+
+    def _start(self):
+        self._queue = queue.Queue(maxsize=self.queue_size)
+        self._error = None
+        self._stop = threading.Event()
+        stop, q = self._stop, self._queue
+
+        def worker():
+            try:
+                while not stop.is_set() and self.underlying.has_next():
+                    item = self._put(self.underlying.next())
+                    while not stop.is_set():
+                        try:
+                            q.put(item, timeout=0.1)
+                            break
+                        except queue.Full:
+                            continue
+            except Exception as e:
+                self._error = e
+            finally:
+                while True:
+                    try:
+                        q.put(self._SENTINEL, timeout=0.1)
+                        break
+                    except queue.Full:
+                        if stop.is_set():
+                            break
+
+        self._thread = threading.Thread(target=worker, daemon=True)
+        self._thread.start()
+        self._peek = None
+        self._done = False
+        self._consumed = False
+        self._fill_peek()
+
+    def _fill_peek(self):
+        if self._done:
+            return
+        v = self._queue.get()
+        if v is self._SENTINEL:
+            self._done = True
+            self._peek = None
+            if self._error:
+                # mark exhausted BEFORE raising: the worker is dead, so a
+                # caller that catches this and polls has_next()/next() again
+                # must not block forever on an empty queue
+                raise self._error
+        else:
+            self._peek = v
+
+    def next(self):
+        v = self._peek
+        self._consumed = True
+        self._fill_peek()
+        return v
+
+    def has_next(self):
+        return not self._done
+
+    def batch(self):
+        return self.underlying.batch()
+
+    def reset(self):
+        if not self._consumed and not self._done:
+            return
+        if self._thread is not None and self._thread.is_alive():
+            self._stop.set()
+            self._thread.join(timeout=5)
+        self.underlying.reset()
+        self._start()
+
+
 def as_iterator(data, batch_size=None):
     """Coerce DataSet / (x, y) / list / iterator into a DataSetIterator."""
     if isinstance(data, DataSetIterator):

@@ -23,19 +23,28 @@ class ConfusionMatrix:
 
 
 class Evaluation:
-    def __init__(self, n_classes=None, labels=None):
+    def __init__(self, n_classes=None, labels=None, top_n=1):
+        """top_n > 1 also tracks top-N accuracy (reference: Evaluation.java
+        topN constructor + topNAccuracy())."""
         self.n_classes = n_classes
         self.label_names = labels
         self.confusion = None
+        self.top_n = int(top_n)
+        self._top_n_correct = 0
+        self._top_n_total = 0
+        self._predictions = []  # Prediction meta (reference: eval/meta/)
 
     def _ensure(self, n):
         if self.confusion is None:
             self.n_classes = self.n_classes or n
             self.confusion = ConfusionMatrix(self.n_classes)
 
-    def eval(self, labels, predictions, mask=None):
+    def eval(self, labels, predictions, mask=None, record_meta_data=None):
         """labels/predictions: [batch, n_classes] probabilities/one-hot, or
-        [batch, time, n_classes] with mask [batch, time]."""
+        [batch, time, n_classes] with mask [batch, time]. record_meta_data:
+        optional per-example metadata recorded onto Prediction objects for
+        error introspection (reference: Evaluation.java eval(...,
+        List<RecordMetaData>) + eval/meta/Prediction.java)."""
         labels = np.asarray(labels)
         predictions = np.asarray(predictions)
         if labels.ndim == 3:
@@ -45,13 +54,26 @@ class Evaluation:
             if mask is not None:
                 m = np.asarray(mask).reshape(b * t) > 0
                 labels, predictions = labels[m], predictions[m]
+            record_meta_data = None  # per-example meta is 2-D only
         elif mask is not None:
             m = np.asarray(mask).reshape(-1) > 0
             labels, predictions = labels[m], predictions[m]
+            if record_meta_data is not None:
+                record_meta_data = [r for r, keep in zip(record_meta_data, m)
+                                    if keep]
         self._ensure(labels.shape[-1])
         actual = np.argmax(labels, axis=-1)
         pred = np.argmax(predictions, axis=-1)
         np.add.at(self.confusion.matrix, (actual, pred), 1)
+        if self.top_n > 1:
+            k = min(self.top_n, predictions.shape[-1])
+            topk = np.argpartition(-predictions, k - 1, axis=-1)[:, :k]
+            self._top_n_correct += int(np.sum(topk == actual[:, None]))
+            self._top_n_total += len(actual)
+        if record_meta_data is not None:
+            from .meta import Prediction
+            for a, pr, meta in zip(actual, pred, record_meta_data):
+                self._predictions.append(Prediction(a, pr, meta))
 
     def eval_time_series(self, labels, predictions, mask=None):
         self.eval(labels, predictions, mask)
@@ -91,6 +113,24 @@ class Evaluation:
         p, r = self.precision(i), self.recall(i)
         return 2 * p * r / (p + r) if (p + r) else 0.0
 
+    def top_n_accuracy(self):
+        """Fraction of examples whose true class is in the top-N predictions
+        (reference: Evaluation.java topNAccuracy())."""
+        if self.top_n <= 1:
+            return self.accuracy()
+        return (self._top_n_correct / self._top_n_total
+                if self._top_n_total else 0.0)
+
+    # ---- prediction-error introspection (reference: eval/meta/) -----------
+    def get_prediction_errors(self):
+        return [p for p in self._predictions if p.actual != p.predicted]
+
+    def get_predictions_by_actual_class(self, i):
+        return [p for p in self._predictions if p.actual == int(i)]
+
+    def get_predictions_by_predicted_class(self, i):
+        return [p for p in self._predictions if p.predicted == int(i)]
+
     def false_positive_rate(self, i):
         tn = self.confusion.matrix.sum() - self._tp(i) - self._fp(i) - self._fn(i)
         d = self._fp(i) + tn
@@ -113,4 +153,7 @@ class Evaluation:
         if other.confusion is not None:
             self._ensure(other.n_classes)
             self.confusion.matrix += other.confusion.matrix
+        self._top_n_correct += other._top_n_correct
+        self._top_n_total += other._top_n_total
+        self._predictions.extend(other._predictions)
         return self

@@ -455,11 +455,15 @@ class H5Reader:
         p = pos + (8 if version == 1 else 2)
         filters = []
         for _ in range(nfilters):
-            fid, name_len = struct.unpack_from("<HH", d, p)
+            fid, = struct.unpack_from("<H", d, p)
             if version == 2 and fid < 256:
+                # v2 omits the name-length field entirely for ids < 256
                 name_len = 0
-            _flags, n_cvals = struct.unpack_from("<HH", d, p + 4)
-            p += 8
+                _flags, n_cvals = struct.unpack_from("<HH", d, p + 2)
+                p += 6
+            else:
+                name_len, _flags, n_cvals = struct.unpack_from("<HHH", d, p + 2)
+                p += 8
             if name_len:
                 pad = _pad8(name_len) if version == 1 else 0
                 p += name_len + pad
@@ -501,7 +505,9 @@ class H5Reader:
         if cls == 9:   # variable-length
             vtype = bits[0] & 0x0F
             if vtype == 1:  # vlen string (h5py stores str attrs this way)
-                return _VlenStr(utf8=bool((bits[0] >> 4) & 0x0F))
+                # character set lives in bit-field bits 8-11 (second byte);
+                # bits 4-7 of byte 0 are the padding type, not the charset
+                return _VlenStr(utf8=(bits[1] & 0x0F) == 1)
             raise NotImplementedError(
                 "variable-length sequence types unsupported")
         raise NotImplementedError(f"datatype class {cls}")
@@ -565,6 +571,8 @@ class H5Reader:
         flags = 0 if version == 1 else d[pos + 1]
         if flags & 0x01:
             raise NotImplementedError("shared attribute datatypes unsupported")
+        if flags & 0x02:
+            raise NotImplementedError("shared attribute dataspaces unsupported")
         name_size, dt_size, ds_size = struct.unpack_from("<HHH", d, pos + 2)
         p = pos + (9 if version == 3 else 8)  # v3 adds a name-charset byte
         pad = _pad8 if version == 1 else (lambda n: 0)  # v2/v3: no padding

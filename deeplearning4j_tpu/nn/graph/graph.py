@@ -95,12 +95,12 @@ class ComputationGraph:
         return self
 
     def _build_updater(self):
-        transforms, labels = {}, {}
-        for name, p in self.params.items():
+        from ..updaters import per_layer_transform
+        transforms = {}
+        for name in self.params:
             lc = self.conf.vertices[name].layer_conf
             transforms[name] = lc.updater.to_optax() if lc.updater is not None else optax.sgd(0.1)
-            labels[name] = jax.tree_util.tree_map(lambda _: name, p)
-        self._tx = optax.multi_transform(transforms, labels)
+        self._tx = per_layer_transform(transforms)
         self.opt_state = self._tx.init(self.params)
 
     # -------------------------------------------------------------- forward
@@ -127,16 +127,16 @@ class ComputationGraph:
             ms = [out_masks.get(i) for i in spec.inputs]
             if spec.kind == "layer":
                 x, m = xs[0], ms[0]
+                if rng is not None:
+                    rng, pre_rng, sub = jax.random.split(rng, 3)
+                else:
+                    pre_rng = sub = None
                 if spec.preprocessor is not None:
-                    x = spec.preprocessor(x, m)
+                    x = spec.preprocessor(x, m, rng=pre_rng)
                     m = spec.preprocessor.feed_forward_mask(m) if m is not None else None
                 kwargs = {}
                 if initial_carries is not None and name in initial_carries:
                     kwargs = {"initial_state": initial_carries[name], "return_state": True}
-                if rng is not None:
-                    rng, sub = jax.random.split(rng)
-                else:
-                    sub = None
                 out = self.layers[name].forward(params[name], states[name], x,
                                                 train=train, rng=sub, mask=m, **kwargs)
                 if len(out) == 4:
@@ -173,8 +173,11 @@ class ComputationGraph:
         if cd is None:
             return params, inputs
         outs = set(self.conf.network_outputs)
+        # uint8 = image pixels (exact in bf16, rescaled on-chip); wider ints
+        # (embedding ids) must not be cast — ids > 256 don't fit bf16
         cast = lambda a: a.astype(cd) \
-            if hasattr(a, "dtype") and jnp.issubdtype(a.dtype, jnp.floating) else a
+            if hasattr(a, "dtype") and (jnp.issubdtype(a.dtype, jnp.floating)
+                                        or a.dtype == jnp.uint8) else a
         params = {k: (v if k in outs else jax.tree_util.tree_map(cast, v))
                   for k, v in params.items()}
         inputs = [cast(x) for x in inputs]
@@ -185,10 +188,14 @@ class ComputationGraph:
               label_masks=None, initial_carries=None):
         conf = self.conf
         params, inputs = self._cast_for_compute(params, inputs)
+        if rng is not None:
+            rng, fwd_rng = jax.random.split(rng)
+        else:
+            fwd_rng = None
         # run everything except output layers' score; output layer forward is
         # replaced by its integrated loss on the features feeding it.
         acts, new_states, out_masks, carries = self._forward(
-            params, states, inputs, train=train, rng=rng, masks=masks,
+            params, states, inputs, train=train, rng=fwd_rng, masks=masks,
             initial_carries=initial_carries)
         total = 0.0
         lm = label_masks or [None] * len(conf.network_outputs)
@@ -199,7 +206,12 @@ class ComputationGraph:
                 raise ValueError(f"Network output '{out_name}' is not an output layer")
             feats = acts[spec.inputs[0]]
             if spec.preprocessor is not None:
-                feats = spec.preprocessor(feats, out_masks.get(spec.inputs[0]))
+                if rng is not None:
+                    rng, pre_rng = jax.random.split(rng)
+                else:
+                    pre_rng = None
+                feats = spec.preprocessor(feats, out_masks.get(spec.inputs[0]),
+                                          rng=pre_rng)
             if self._compute_dtype() is not None:
                 feats = feats.astype(self._dtype)  # loss math in full precision
             mask = mlab if mlab is not None else out_masks.get(spec.inputs[0])

@@ -55,6 +55,11 @@ def _masked_score(per_elem, mask, sum_features=True):
     if mask.ndim == 1:
         per_ex = jnp.sum(flat, axis=-1) if sum_features else jnp.mean(flat, axis=-1)
         return jnp.sum(per_ex * mask) / jnp.maximum(jnp.sum(mask), 1.0)
+    if mask.ndim >= 2 and mask.shape == per_elem.shape[:-1]:
+        # per-position mask (e.g. [b, t] over [b, t, c]): average over active
+        # positions, matching the RnnOutputLayer reshape semantics
+        pos = jnp.sum(per_elem, axis=-1) if sum_features else jnp.mean(per_elem, axis=-1)
+        return jnp.sum(pos * mask) / jnp.maximum(jnp.sum(mask), 1.0)
     bmask = jnp.broadcast_to(mask.reshape(b, -1), flat.shape) if mask.size != flat.size else mask.reshape(b, -1)
     masked = flat * bmask
     if sum_features:

@@ -94,18 +94,18 @@ class MultiLayerNetwork:
     def _build_updater(self):
         """Per-layer optax transforms (each layer may override the updater —
         reference: LayerUpdater per layer, UpdaterCreator)."""
-        transforms, labels = {}, {}
+        from ..updaters import per_layer_transform
+        transforms = {}
         for i, lc in enumerate(self.conf.layers):
             transforms[str(i)] = lc.updater.to_optax() if lc.updater is not None else optax.sgd(0.1)
-            labels[str(i)] = jax.tree_util.tree_map(lambda _: str(i), self.params[str(i)])
-        self._tx = optax.multi_transform(transforms, labels)
+        self._tx = per_layer_transform(transforms)
         self.opt_state = self._tx.init(self.params)
 
     # -------------------------------------------------------------- forward
-    def _apply_preprocessor(self, i, x, mask):
+    def _apply_preprocessor(self, i, x, mask, rng=None):
         pre = self.conf.input_preprocessors.get(i)
         if pre is not None:
-            x = pre(x, mask)
+            x = pre(x, mask, rng=rng)
             mask = pre.feed_forward_mask(mask) if mask is not None else None
         return x, mask
 
@@ -120,14 +120,14 @@ class MultiLayerNetwork:
         cur_mask = mask
         for i in range(n):
             layer = self.layers[i]
-            x, cur_mask = self._apply_preprocessor(i, x, cur_mask)
+            if rng is not None:
+                rng, pre_rng, sub = jax.random.split(rng, 3)
+            else:
+                pre_rng = sub = None
+            x, cur_mask = self._apply_preprocessor(i, x, cur_mask, rng=pre_rng)
             kwargs = {}
             if initial_carries is not None and str(i) in initial_carries:
                 kwargs = {"initial_state": initial_carries[str(i)], "return_state": True}
-            if rng is not None:
-                rng, sub = jax.random.split(rng)
-            else:
-                sub = None
             out = layer.forward(params[str(i)], states[str(i)], x, train=train,
                                 rng=sub, mask=cur_mask, **kwargs)
             if len(out) == 4:
@@ -165,7 +165,12 @@ class MultiLayerNetwork:
             return params, x
         params = {k: (v if k in keep_f32 else self._cast_floats(v, cd))
                   for k, v in params.items()}
-        if hasattr(x, "dtype") and jnp.issubdtype(x.dtype, jnp.floating):
+        if hasattr(x, "dtype") and (jnp.issubdtype(x.dtype, jnp.floating)
+                                    or x.dtype == jnp.uint8):
+            # uint8 covers the image-pixels-on-the-wire path: values 0..255
+            # are exact in bf16 (ImageScalerPreProcessor rescales on-chip).
+            # Wider integer inputs (embedding token ids) must NOT be cast —
+            # ids > 256 are not representable in bf16.
             x = x.astype(cd)
         return params, x
 
@@ -174,11 +179,16 @@ class MultiLayerNetwork:
               initial_carries=None):
         out_idx = len(self.layers) - 1
         params, x = self._cast_for_compute(params, x, keep_f32=(str(out_idx),))
+        if rng is not None:
+            rng, fwd_rng, pre_rng = jax.random.split(rng, 3)
+        else:
+            fwd_rng = pre_rng = None
         feats, new_states, cur_mask, carries, _ = self._forward(
-            params, states, x, train=train, rng=rng, mask=mask, to_layer=out_idx,
+            params, states, x, train=train, rng=fwd_rng, mask=mask, to_layer=out_idx,
             initial_carries=initial_carries)
         out_layer = self.layers[out_idx]
-        feats, cur_mask = self._apply_preprocessor(out_idx, feats, cur_mask)
+        feats, cur_mask = self._apply_preprocessor(out_idx, feats, cur_mask,
+                                                   rng=pre_rng)
         if self._compute_dtype() is not None:
             feats = feats.astype(self._dtype)  # loss math in full precision
         if not out_layer.is_output_layer():

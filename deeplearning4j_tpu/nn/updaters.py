@@ -195,6 +195,30 @@ class NoOp(BaseUpdater):
         return optax.set_to_zero()
 
 
+def per_layer_transform(transforms: dict):
+    """Top-level-partitioned optimizer: transforms[name] updates only
+    params[name]'s subtree.
+
+    Replaces optax.multi_transform for the per-layer-updater contract
+    (reference: one LayerUpdater per layer, nn/updater/LayerUpdater.java:29):
+    multi_transform traverses the FULL tree once per label with masked
+    leaves — O(L²) op count for L layers, measured ~78 ms/step on the
+    ResNet-50 train step (161 labels) vs <2 ms for this partition."""
+    def init(params):
+        return {k: transforms[k].init(v) for k, v in params.items()}
+
+    def update(grads, state, params=None):
+        ups, new_state = {}, {}
+        for k, g in grads.items():
+            u, s = transforms[k].update(
+                g, state[k], None if params is None else params[k])
+            ups[k] = u
+            new_state[k] = s
+        return ups, new_state
+
+    return optax.GradientTransformation(init, update)
+
+
 # ---------------------------------------------------------------------------
 # Gradient normalization (reference: GradientNormalization enum + LayerUpdater.java:182-194)
 # ---------------------------------------------------------------------------

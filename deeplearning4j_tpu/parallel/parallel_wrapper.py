@@ -76,21 +76,16 @@ class ParallelWrapper:
         return ParallelWrapper.Builder(model)
 
     def fit(self, iterator, epochs=1):
-        """(reference: ParallelWrapper.fit :322) Batches must be divisible by
-        `workers`; each step shards the global batch over the data axis."""
+        """(reference: ParallelWrapper.fit :322) Each step shards the global
+        batch over the data axis; partial batches are wrap-padded with
+        loss-masked rows, so no example is dropped."""
         it = as_iterator(iterator)
         if self.prefetch_buffer and it.async_supported():
             it = AsyncDataSetIterator(it, queue_size=self.prefetch_buffer)
-        trained = 0
         for _ in range(epochs):
             it.reset()
             for ds in it:
-                if self.trainer.fit_batch(ds) is not None:
-                    trained += 1
-        if trained == 0:
-            raise ValueError(
-                f"no batch was large enough for the {self.workers}-way data "
-                f"axis — nothing trained; increase batch_size or reduce workers")
+                self.trainer.fit_batch(ds)
         return self.model
 
     def shutdown(self):

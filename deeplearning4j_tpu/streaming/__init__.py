@@ -1,0 +1,21 @@
+"""Streaming: online inference serving + message-bus style routes.
+
+Reference: dl4j-streaming (1.3k LoC) —
+routes/DL4jServeRouteBuilder.java:56-105 (Camel route: consume serialized
+records from Kafka, run model.output, publish predictions),
+kafka/NDArrayKafkaClient.java (NDArray publish/consume),
+serde/RecordSerializer.java (wire format).
+
+TPU-first redesign: the Camel/Kafka machinery collapses to a pluggable
+Source/Sink SPI around a jit-compiled `model.output` hot path — the broker
+integration is host-side IO and framework-agnostic, so the in-repo
+implementations are an HTTP server (`InferenceServer`) and in-memory
+queues (`QueueSource`/`QueueSink`) with the same route semantics.
+"""
+from .serde import NDArrayMessage, serialize_array, deserialize_array
+from .routes import StreamSource, StreamSink, QueueSource, QueueSink, ServeRoute
+from .serve import InferenceServer
+
+__all__ = ["NDArrayMessage", "serialize_array", "deserialize_array",
+           "StreamSource", "StreamSink", "QueueSource", "QueueSink",
+           "ServeRoute", "InferenceServer"]

@@ -1,0 +1,132 @@
+"""Streaming routes: Source -> model -> Sink pipelines.
+
+Reference: dl4j-streaming routes/DL4jServeRouteBuilder.java:56-105 — a Camel
+route that (1) consumes serialized records from a Kafka endpoint, (2) converts
+them to NDArrays, (3) runs `model.output`, (4) publishes predictions to an
+output endpoint. The Kafka/Camel specifics are host-side IO; the SPI below
+keeps the route shape with pluggable endpoints (an actual broker client would
+implement StreamSource/StreamSink the same way the in-memory queues do).
+"""
+from __future__ import annotations
+
+import queue
+import threading
+
+import numpy as np
+
+from .serde import NDArrayMessage
+
+
+class StreamSource:
+    """Endpoint the route consumes from (Kafka consumer analog)."""
+
+    def poll(self, timeout=None):
+        """Return the next NDArrayMessage, or None on timeout/closed."""
+        raise NotImplementedError
+
+    def close(self):
+        pass
+
+
+class StreamSink:
+    """Endpoint the route publishes to (Kafka producer analog)."""
+
+    def publish(self, message: NDArrayMessage):
+        raise NotImplementedError
+
+    def close(self):
+        pass
+
+
+class QueueSource(StreamSource):
+    """In-memory bounded-queue source (test/bench endpoint; the reference's
+    tests use an embedded Kafka broker the same way)."""
+
+    def __init__(self, maxsize=1024):
+        self._q = queue.Queue(maxsize=maxsize)
+        self._closed = False
+
+    def put(self, message):
+        if not isinstance(message, NDArrayMessage):
+            message = NDArrayMessage(message)
+        self._q.put(message)
+
+    def poll(self, timeout=None):
+        try:
+            return self._q.get(timeout=timeout)
+        except queue.Empty:
+            return None
+
+    def close(self):
+        self._closed = True
+
+
+class QueueSink(StreamSink):
+    def __init__(self):
+        self.messages = []
+        self._lock = threading.Lock()
+
+    def publish(self, message):
+        with self._lock:
+            self.messages.append(message)
+
+
+class ServeRoute:
+    """The DL4jServeRouteBuilder equivalent: a background consumer loop that
+    batches pending records, runs the jitted `model.output` once per batch
+    (records are micro-batched so the MXU sees one large matmul instead of N
+    tiny ones), and publishes one prediction message per input record."""
+
+    def __init__(self, model, source: StreamSource, sink: StreamSink,
+                 max_batch=64, poll_timeout=0.05, transform=None):
+        self.model = model
+        self.source = source
+        self.sink = sink
+        self.max_batch = int(max_batch)
+        self.poll_timeout = float(poll_timeout)
+        self.transform = transform
+        self._stop = threading.Event()
+        self._thread = None
+        self.processed = 0
+
+    def _drain_batch(self):
+        msgs = []
+        m = self.source.poll(timeout=self.poll_timeout)
+        if m is None:
+            return msgs
+        msgs.append(m)
+        while len(msgs) < self.max_batch:
+            m = self.source.poll(timeout=0)
+            if m is None:
+                break
+            msgs.append(m)
+        return msgs
+
+    def _serve_loop(self):
+        while not self._stop.is_set():
+            msgs = self._drain_batch()
+            if not msgs:
+                continue
+            batch = np.concatenate([m.array for m in msgs], axis=0)
+            if self.transform is not None:
+                batch = self.transform(batch)
+            preds = np.asarray(self.model.output(batch))
+            off = 0
+            for m in msgs:
+                n = m.array.shape[0]
+                self.sink.publish(NDArrayMessage(preds[off:off + n], m.meta))
+                off += n
+            self.processed += len(msgs)
+
+    def start(self):
+        self._stop.clear()
+        self._thread = threading.Thread(target=self._serve_loop, daemon=True)
+        self._thread.start()
+        return self
+
+    def stop(self, timeout=5):
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=timeout)
+        self.source.close()
+        self.sink.close()

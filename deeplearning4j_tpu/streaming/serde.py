@@ -1,0 +1,48 @@
+"""NDArray wire serde for streaming routes.
+
+Reference: dl4j-streaming serde/RecordSerializer.java and
+kafka/NDArrayKafkaClient.java (NDArrays published to Kafka as base64-encoded
+binary records inside JSON envelopes).
+
+Format: JSON envelope {"shape", "dtype", "data"(base64 C-order bytes)} —
+self-describing, broker-agnostic, and compact enough for message buses.
+"""
+from __future__ import annotations
+
+import base64
+import json
+
+import numpy as np
+
+
+def serialize_array(arr) -> str:
+    a = np.asarray(arr)
+    return json.dumps({
+        "shape": list(a.shape),
+        "dtype": a.dtype.name,
+        "data": base64.b64encode(np.ascontiguousarray(a).tobytes()).decode("ascii"),
+    })
+
+
+def deserialize_array(payload) -> np.ndarray:
+    d = json.loads(payload) if isinstance(payload, (str, bytes)) else payload
+    raw = base64.b64decode(d["data"])
+    return np.frombuffer(raw, dtype=np.dtype(d["dtype"])).reshape(d["shape"]).copy()
+
+
+class NDArrayMessage:
+    """One streaming record: an ndarray plus optional metadata (the analog of
+    the reference's Kafka record with its topic/partition headers)."""
+
+    def __init__(self, array, meta=None):
+        self.array = np.asarray(array)
+        self.meta = dict(meta or {})
+
+    def to_json(self) -> str:
+        return json.dumps({"array": json.loads(serialize_array(self.array)),
+                           "meta": self.meta})
+
+    @staticmethod
+    def from_json(payload) -> "NDArrayMessage":
+        d = json.loads(payload) if isinstance(payload, (str, bytes)) else payload
+        return NDArrayMessage(deserialize_array(d["array"]), d.get("meta"))

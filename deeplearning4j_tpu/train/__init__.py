@@ -1,0 +1,12 @@
+"""Fault-tolerant training driver: periodic checkpoint + preemption resume.
+
+SURVEY.md §5 names this a TPU must-add with no reference counterpart ("no
+elastic worker membership, no preemption handling"); the closest reference
+analogs are Spark's RDD-lineage task retry and the download retry loop at
+deeplearning4j-core/.../base/MnistFetcher.java:103-107. TPUs are preemptible,
+so the driver must assume the process can die at any step and training must
+continue from the last checkpoint — including mid-epoch iterator position.
+"""
+from .fault_tolerance import CheckpointConfig, FaultTolerantTrainer
+
+__all__ = ["CheckpointConfig", "FaultTolerantTrainer"]

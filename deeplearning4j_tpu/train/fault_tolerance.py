@@ -1,0 +1,146 @@
+"""Checkpoint-restart fault tolerance.
+
+Design (TPU-native, no reference counterpart — SURVEY.md §5 gap):
+- atomic checkpoints: write to `<dir>/tmp-*` then os.replace into place, so a
+  preemption mid-write never corrupts the latest checkpoint;
+- training state beyond weights: epoch, batch index within the epoch, total
+  iteration count, and the model's PRNG key all persist, so the resumed loss
+  curve continues where the dead process stopped (mid-epoch included);
+- the model file is the standard ModelSerializer zip (configuration.json +
+  coefficients + updater state — util/model_serializer.py), so any checkpoint
+  doubles as a normal saved model;
+- `FaultTolerantTrainer.fit` skips already-consumed batches when resuming
+  mid-epoch by fast-forwarding the iterator.
+
+Reference analogs for the retry/resume idea: Spark task retry (RDD lineage),
+MnistFetcher.java:103-107 download retry.
+"""
+from __future__ import annotations
+
+import json
+import os
+import tempfile
+
+import numpy as np
+
+from ..util.model_serializer import ModelSerializer
+
+
+class CheckpointConfig:
+    def __init__(self, directory, frequency=50, keep_last=2):
+        self.directory = str(directory)
+        self.frequency = int(frequency)
+        self.keep_last = int(keep_last)
+
+
+class FaultTolerantTrainer:
+    """Drives `model.fit`-style training with periodic atomic checkpoints and
+    preemption resume.
+
+    Usage:
+        trainer = FaultTolerantTrainer(model_factory, CheckpointConfig(dir))
+        trainer.fit(iterator, epochs=N)   # auto-resumes if checkpoints exist
+    `model_factory()` builds the (un-initialized) model when no checkpoint
+    exists; on resume the model is restored from the newest checkpoint.
+    """
+
+    STATE_FILE = "train_state.json"
+    MODEL_FILE = "model.zip"
+
+    def __init__(self, model_or_factory, checkpoint: CheckpointConfig):
+        self.ckpt = checkpoint
+        os.makedirs(self.ckpt.directory, exist_ok=True)
+        self._factory = (model_or_factory if callable(model_or_factory)
+                         else (lambda: model_or_factory))
+        self.model = None
+        self.state = {"epoch": 0, "batch": 0, "iteration": 0, "rng": None}
+        self._restored = self._try_restore()
+
+    # ------------------------------------------------------------ checkpoint
+    def _ckpt_dirs(self):
+        out = []
+        for name in os.listdir(self.ckpt.directory):
+            if name.startswith("ckpt-") and os.path.isfile(
+                    os.path.join(self.ckpt.directory, name, self.STATE_FILE)):
+                out.append(name)
+        return sorted(out, key=lambda n: int(n.split("-")[1]))
+
+    def checkpoint(self):
+        """Write an atomic checkpoint of model + training state."""
+        it = self.state["iteration"]
+        final = os.path.join(self.ckpt.directory, f"ckpt-{it:09d}")
+        if os.path.isdir(final):
+            return final  # this iteration is already durably checkpointed
+        tmp = tempfile.mkdtemp(prefix="tmp-", dir=self.ckpt.directory)
+        try:
+            ModelSerializer.write_model(self.model,
+                                        os.path.join(tmp, self.MODEL_FILE))
+            st = dict(self.state)
+            rng = getattr(self.model, "_rng", None)
+            st["rng"] = None if rng is None else np.asarray(rng).tolist()
+            with open(os.path.join(tmp, self.STATE_FILE), "w") as f:
+                json.dump(st, f)
+            os.replace(tmp, final)  # atomic publish
+        except Exception:
+            import shutil
+            shutil.rmtree(tmp, ignore_errors=True)
+            raise
+        self._gc()
+        return final
+
+    def _gc(self):
+        import shutil
+        dirs = self._ckpt_dirs()
+        for name in dirs[:-self.ckpt.keep_last]:
+            shutil.rmtree(os.path.join(self.ckpt.directory, name),
+                          ignore_errors=True)
+
+    def _try_restore(self):
+        dirs = self._ckpt_dirs()
+        if not dirs:
+            self.model = self._factory()
+            if getattr(self.model, "params", None) is None:
+                self.model.init()
+            return False
+        latest = os.path.join(self.ckpt.directory, dirs[-1])
+        self.model = ModelSerializer.restore(
+            os.path.join(latest, self.MODEL_FILE))
+        with open(os.path.join(latest, self.STATE_FILE)) as f:
+            self.state = json.load(f)
+        rng = self.state.get("rng")
+        if rng is not None:
+            import jax.numpy as jnp
+            self.model._rng = jnp.asarray(np.asarray(rng, dtype=np.uint32))
+        self.model.iteration_count = self.state["iteration"]
+        self.model.epoch_count = self.state["epoch"]
+        return True
+
+    @property
+    def resumed(self):
+        return self._restored
+
+    # ------------------------------------------------------------ training
+    def fit(self, iterator, epochs=1):
+        """Train with checkpoints every `frequency` iterations; on resume,
+        fast-forwards past the batches the dead process already consumed."""
+        from ..datasets.iterator.base import as_iterator
+        it = as_iterator(iterator)
+        freq = self.ckpt.frequency
+        start_epoch = self.state["epoch"]
+        for epoch in range(start_epoch, epochs):
+            it.reset()
+            skip = self.state["batch"] if epoch == self.state["epoch"] else 0
+            b = 0
+            for ds in it:
+                if b < skip:
+                    b += 1
+                    continue
+                self.model.fit_batch(ds)
+                b += 1
+                self.state.update(epoch=epoch, batch=b,
+                                  iteration=self.state["iteration"] + 1)
+                if freq and self.state["iteration"] % freq == 0:
+                    self.checkpoint()
+            self.state.update(epoch=epoch + 1, batch=0)
+        self.checkpoint()
+        return self.model

@@ -1,0 +1,62 @@
+"""Viterbi decoder for denoising label sequences.
+
+Reference: deeplearning4j-nn/.../util/Viterbi.java — a Markov-chain smoother
+over classifier outcome sequences: states tend to persist (self-transition
+probability `meta_stability`), observations are correct with probability
+`p_correct`. The reference's dynamic program leaves its backpointer matrix
+unfilled (a long-standing upstream bug); this implementation keeps the same
+constructor/decode contract but runs the standard, correct Viterbi recursion
+with backtracking.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+
+class Viterbi:
+    def __init__(self, possible_labels, meta_stability=0.9, p_correct=0.99):
+        self.possible_labels = np.asarray(possible_labels)
+        self.states = int(self.possible_labels.shape[0])
+        if self.states < 2:
+            raise ValueError("need at least 2 states")
+        self.meta_stability = float(meta_stability)
+        self.p_correct = float(p_correct)
+        # log transition matrix: diagonal = stay, off-diagonal splits the rest
+        off = (1.0 - self.meta_stability) / (self.states - 1)
+        T = np.full((self.states, self.states), np.log(off))
+        np.fill_diagonal(T, np.log(self.meta_stability))
+        self._logT = T
+        # log emission: observed == state with p_correct
+        self._log_correct = np.log(self.p_correct)
+        self._log_incorrect = np.log((1.0 - self.p_correct) / (self.states - 1))
+
+    def _to_outcomes(self, labels):
+        labels = np.asarray(labels)
+        if labels.ndim == 2 and labels.shape[1] > 1:  # binary label matrix
+            return np.argmax(labels, axis=1)
+        return labels.reshape(-1).astype(int)
+
+    def decode(self, labels, binary_label_matrix=True):
+        """Returns (log_likelihood, decoded_sequence). `labels` is either a
+        [T, states] one-hot matrix (binary_label_matrix=True, reference
+        default) or a length-T outcome vector."""
+        obs = self._to_outcomes(labels) if binary_label_matrix else \
+            np.asarray(labels).reshape(-1).astype(int)
+        frames = len(obs)
+        if frames == 0:
+            return 0.0, np.zeros((0,), int)
+        S = self.states
+        emit = np.full((frames, S), self._log_incorrect)
+        emit[np.arange(frames), obs] = self._log_correct
+        V = np.zeros((frames, S))
+        ptr = np.zeros((frames, S), int)
+        V[0] = -np.log(S) + emit[0]
+        for t in range(1, frames):
+            scores = V[t - 1][:, None] + self._logT  # [from, to]
+            ptr[t] = np.argmax(scores, axis=0)
+            V[t] = scores[ptr[t], np.arange(S)] + emit[t]
+        path = np.zeros(frames, int)
+        path[-1] = int(np.argmax(V[-1]))
+        for t in range(frames - 2, -1, -1):
+            path[t] = ptr[t + 1][path[t + 1]]
+        return float(np.max(V[-1])), self.possible_labels[path]

@@ -233,3 +233,64 @@ def test_kmeans_all_identical_points():
     cs = KMeansClustering(k=3, seed=7).fit(x)
     assert len(cs.centers) == 3
     np.testing.assert_allclose(np.asarray(cs.centers), 1.0)
+
+
+# ------------------------------------------------ top-N / prediction meta
+
+def test_evaluation_top_n_and_prediction_meta():
+    from deeplearning4j_tpu.eval.evaluation import Evaluation
+    import numpy as np
+    e = Evaluation(top_n=3)
+    labels = np.eye(5)[[0, 1, 2, 3]]
+    preds = np.array([
+        [0.5, 0.2, 0.1, 0.1, 0.1],   # correct, top1
+        [0.4, 0.3, 0.2, 0.05, 0.05], # wrong top1, actual=1 in top3
+        [0.3, 0.3, 0.05, 0.3, 0.05], # wrong, actual=2 not in top3
+        [0.1, 0.2, 0.3, 0.35, 0.05], # correct
+    ])
+    e.eval(labels, preds, record_meta_data=["r0", "r1", "r2", "r3"])
+    assert e.accuracy() == 0.5
+    assert e.top_n_accuracy() == 0.75
+    errs = e.get_prediction_errors()
+    assert {p.record_meta for p in errs} == {"r1", "r2"}
+    assert [p.record_meta for p in e.get_predictions_by_actual_class(1)] == ["r1"]
+    assert [p.predicted for p in e.get_predictions_by_predicted_class(0)] == [0, 0, 0]
+
+
+def test_viterbi_denoises_sequence():
+    from deeplearning4j_tpu.util.viterbi import Viterbi
+    import numpy as np
+    v = Viterbi(np.arange(3), meta_stability=0.95, p_correct=0.9)
+    # long stable runs with one-frame noise blips -> blips smoothed out
+    obs = np.array([0]*10 + [1] + [0]*10 + [2]*15 + [0] + [2]*5)
+    ll, path = v.decode(obs, binary_label_matrix=False)
+    expect = np.array([0]*21 + [2]*21)
+    np.testing.assert_array_equal(path, expect)
+    assert ll < 0
+    # binary label matrix input form (reference default)
+    onehot = np.eye(3)[obs]
+    _, path2 = v.decode(onehot)
+    np.testing.assert_array_equal(path2, path)
+
+
+def test_quadtree_structure_and_forces():
+    from deeplearning4j_tpu.clustering.quadtree import QuadTree
+    import numpy as np
+    rng = np.random.default_rng(0)
+    pts = rng.normal(size=(64, 2))
+    qt = QuadTree(pts)
+    assert qt.cum_size == 64
+    np.testing.assert_allclose(qt.center_of_mass, pts.mean(0), atol=1e-9)
+    assert qt.depth() > 1
+    # Barnes-Hut force at theta=0 (exact) matches brute force
+    p = pts[0]
+    neg, sum_q = qt.compute_non_edge_forces(p, theta=0.0)
+    diffs = p - pts[1:]
+    d2 = np.sum(diffs**2, axis=1)
+    q = 1.0 / (1.0 + d2)
+    np.testing.assert_allclose(sum_q, q.sum(), rtol=1e-9)
+    np.testing.assert_allclose(neg, ((q**2)[:, None] * diffs).sum(0), rtol=1e-9,
+                               atol=1e-12, err_msg="exact BH must equal brute force")
+    # approximate forces stay close
+    neg_a, sum_qa = qt.compute_non_edge_forces(p, theta=0.5)
+    assert abs(sum_qa - q.sum()) / q.sum() < 0.1

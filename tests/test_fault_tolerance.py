@@ -1,0 +1,135 @@
+"""Preemption / checkpoint-restart tests (SURVEY.md §5 must-add: TPUs are
+preemptible; the driver must survive a killed process and continue the loss
+curve from the last checkpoint, mid-epoch included)."""
+import os
+import subprocess
+import sys
+import textwrap
+
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu import (NeuralNetConfiguration, InputType, DenseLayer,
+                                OutputLayer, MultiLayerNetwork, DataSet,
+                                ListDataSetIterator, Sgd)
+from deeplearning4j_tpu.train import CheckpointConfig, FaultTolerantTrainer
+
+
+def _factory(seed=11):
+    def make():
+        conf = (NeuralNetConfiguration.builder()
+                .seed(seed).updater(Sgd(0.1)).list()
+                .layer(DenseLayer(n_out=16, activation="tanh"))
+                .layer(OutputLayer(n_out=3, activation="softmax", loss="MCXENT"))
+                .input_type(InputType.feed_forward(8))
+                .build())
+        return MultiLayerNetwork(conf)
+    return make
+
+
+def _data(n=80, seed=0):
+    rng = np.random.default_rng(seed)
+    X = rng.normal(size=(n, 8)).astype(np.float32)
+    w = rng.normal(size=(8, 3))
+    Y = np.eye(3, dtype=np.float32)[np.argmax(X @ w, axis=1)]
+    return X, Y
+
+
+def test_checkpoint_resume_in_process(tmp_path):
+    X, Y = _data()
+    it = ListDataSetIterator(DataSet(X, Y), batch_size=8)  # 10 batches/epoch
+
+    # uninterrupted reference run
+    ref = FaultTolerantTrainer(_factory(), CheckpointConfig(tmp_path / "ref",
+                                                            frequency=0))
+    ref.fit(it, epochs=2)
+
+    # interrupted run: train only epoch 1 (10 iters) with freq 7 -> last
+    # checkpoint at iteration 7; then build a NEW trainer from the same dir
+    # (as a restarted process would) and finish
+    ck = CheckpointConfig(tmp_path / "ckpt", frequency=7)
+    t1 = FaultTolerantTrainer(_factory(), ck)
+    assert not t1.resumed
+    t1.fit(it, epochs=1)  # checkpoints at 7, 10(final)
+
+    t2 = FaultTolerantTrainer(_factory(), ck)
+    assert t2.resumed
+    assert t2.state["iteration"] == 10 and t2.state["epoch"] == 1
+    t2.fit(it, epochs=2)
+    np.testing.assert_allclose(ref.model.get_flat_params(),
+                               t2.model.get_flat_params(), rtol=1e-6, atol=1e-7)
+
+
+_KILLED_SCRIPT = textwrap.dedent("""
+    import os, sys
+    import numpy as np
+    sys.path.insert(0, {repo!r})
+    sys.path.insert(0, {testdir!r})
+    import jax
+    # numerics must match the pytest parent (conftest.py): CPU + x64 enabled,
+    # else replayed steps drift by ~1e-4 and the bitwise comparison fails
+    jax.config.update("jax_platforms", "cpu")
+    jax.config.update("jax_enable_x64", True)
+    from test_fault_tolerance import _factory, _data
+    from deeplearning4j_tpu import DataSet, ListDataSetIterator
+    from deeplearning4j_tpu.train import CheckpointConfig, FaultTolerantTrainer
+
+    X, Y = _data()
+    it = ListDataSetIterator(DataSet(X, Y), batch_size=8)
+    trainer = FaultTolerantTrainer(_factory(), CheckpointConfig({ckdir!r},
+                                                                frequency=5))
+
+    class Killer:
+        def iteration_done(self, model, iteration):
+            if trainer.state["iteration"] >= 12:
+                os._exit(17)   # hard preemption: no cleanup, no atexit
+        def on_epoch_start(self, model):
+            pass
+        def on_epoch_end(self, model):
+            pass
+        def record_batch_size(self, b):
+            pass
+
+    trainer.model.set_listeners(Killer())
+    trainer.fit(it, epochs=2)
+    os._exit(0)  # unreachable if the kill fired
+""")
+
+
+def test_preemption_kill_and_resume_matches_uninterrupted(tmp_path):
+    """Kill the training process mid-epoch (SIGKILL-style os._exit), resume in
+    a fresh trainer, and require the final params to MATCH an uninterrupted
+    run bit-for-bit in replayed batch order (checkpointed rng + iterator
+    position make the resume deterministic)."""
+    X, Y = _data()
+    it = ListDataSetIterator(DataSet(X, Y), batch_size=8)
+    ref = FaultTolerantTrainer(_factory(), CheckpointConfig(tmp_path / "ref",
+                                                            frequency=0))
+    ref.fit(it, epochs=2)
+
+    ckdir = str(tmp_path / "ckpt")
+    script = _KILLED_SCRIPT.format(repo=os.getcwd(),
+                                   testdir=os.path.dirname(__file__),
+                                   ckdir=ckdir)
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    proc = subprocess.run([sys.executable, "-c", script], env=env,
+                          capture_output=True, timeout=300)
+    assert proc.returncode == 17, proc.stderr.decode()[-2000:]
+
+    t = FaultTolerantTrainer(_factory(), CheckpointConfig(ckdir, frequency=5))
+    assert t.resumed
+    # the process died at iteration 12; the newest surviving checkpoint is 10
+    assert t.state["iteration"] == 10
+    t.fit(it, epochs=2)
+    np.testing.assert_allclose(ref.model.get_flat_params(),
+                               t.model.get_flat_params(), rtol=1e-6, atol=1e-7)
+
+
+def test_checkpoint_gc_keeps_last(tmp_path):
+    X, Y = _data(n=40)
+    it = ListDataSetIterator(DataSet(X, Y), batch_size=8)  # 5 batches/epoch
+    ck = CheckpointConfig(tmp_path / "ck", frequency=2, keep_last=2)
+    t = FaultTolerantTrainer(_factory(), ck)
+    t.fit(it, epochs=2)  # iters 1..10, ckpts at 2,4,6,8,10 + final
+    names = sorted(os.listdir(ck.directory))
+    assert len([n for n in names if n.startswith("ckpt-")]) <= 2
