@@ -100,6 +100,14 @@ def bench_resnet50(batch=256, image=224, steps=20, warmup=3,
                    updater=Nesterovs(learning_rate=0.05, momentum=0.9),
                    compute_dtype=compute_dtype)
     net.init()
+    if os.environ.get("BENCH_PROFILE"):
+        # capture an XLA profile of a few steady-state steps so perf
+        # regressions are inspectable (ui/stats.py ProfilerListener; view the
+        # TensorBoard trace under $BENCH_PROFILE)
+        from deeplearning4j_tpu.ui.stats import ProfilerListener
+        net.set_listeners(ProfilerListener(os.environ["BENCH_PROFILE"],
+                                           start_iteration=warmup + 2,
+                                           n_iterations=5))
     rng = np.random.default_rng(0)
     n_buf = 2
     batches = []

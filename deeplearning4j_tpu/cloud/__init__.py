@@ -1,0 +1,23 @@
+"""Cloud provisioning + blob storage.
+
+Reference: deeplearning4j-scaleout/deeplearning4j-aws (1.5k LoC) —
+ec2/provision/{ClusterSetup,HostProvisioner}.java (jsch SSH provisioning of
+EC2 workers) and s3/{uploader/S3Uploader, reader/S3Downloader,
+reader/BaseS3DataSetIterator}.java (S3 blob IO + dataset iteration).
+
+TPU redesign: on TPU fleets the "cluster" is a provisioned slice reached over
+SSH and the blob store is GCS/S3-compatible object storage. The module keeps
+the same two capability surfaces with pluggable backends:
+- BlobStore SPI (upload/download/list/iterate-DataSets) with a local
+  filesystem implementation always available and object-store backends gated
+  on their client libraries being installed (no pip installs here);
+- ClusterSetup/HostProvisioner over a Transport SPI (LocalTransport runs
+  commands in-process for tests; SshTransport shells out to ssh/scp the way
+  HostProvisioner.java drives jsch).
+"""
+from .storage import BlobStore, LocalBlobStore, BlobDataSetIterator, get_blob_store
+from .provision import ClusterSetup, HostProvisioner, LocalTransport, SshTransport
+
+__all__ = ["BlobStore", "LocalBlobStore", "BlobDataSetIterator",
+           "get_blob_store", "ClusterSetup", "HostProvisioner",
+           "LocalTransport", "SshTransport"]

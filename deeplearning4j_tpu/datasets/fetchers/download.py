@@ -1,0 +1,97 @@
+"""Download-and-cache with retries, checksums, and archive extraction.
+
+Reference: deeplearning4j-core/.../base/MnistFetcher.java:67 downloadAndUntar
+(fetch to a local cache dir, skip when present) with the retry loop at
+:103-107 (re-download on checksum mismatch, bounded attempts). Works for any
+urllib-supported scheme — including file:// so the machinery is testable in
+the zero-egress build environment; in production the same code pulls over
+https.
+"""
+from __future__ import annotations
+
+import gzip
+import hashlib
+import os
+import shutil
+import tarfile
+import time
+import urllib.request
+import zipfile
+
+DEFAULT_CACHE = os.path.join(os.path.expanduser("~"), ".deeplearning4j_tpu",
+                             "data")
+
+
+def _md5(path):
+    h = hashlib.md5()
+    with open(path, "rb") as f:
+        for chunk in iter(lambda: f.read(1 << 20), b""):
+            h.update(chunk)
+    return h.hexdigest()
+
+
+def download_file(url, dest, md5=None, max_tries=3, backoff_s=1.0):
+    """Fetch url -> dest with bounded retries and optional md5 validation
+    (reference: MnistFetcher.downloadAndUntar retry loop :103-107). Returns
+    dest; raises after max_tries failures. An existing file with a matching
+    checksum (or any existing file when no checksum is given) is reused."""
+    dest = str(dest)
+    if os.path.exists(dest) and (md5 is None or _md5(dest) == md5):
+        return dest
+    os.makedirs(os.path.dirname(os.path.abspath(dest)), exist_ok=True)
+    last = None
+    for attempt in range(max_tries):
+        tmp = dest + ".part"
+        try:
+            with urllib.request.urlopen(url) as r, open(tmp, "wb") as f:
+                shutil.copyfileobj(r, f)
+            if md5 is not None and _md5(tmp) != md5:
+                raise IOError(f"checksum mismatch for {url}")
+            os.replace(tmp, dest)
+            return dest
+        except Exception as e:
+            last = e
+            if os.path.exists(tmp):
+                os.remove(tmp)
+            if attempt + 1 < max_tries:
+                time.sleep(backoff_s * (attempt + 1))
+    raise IOError(f"failed to download {url} after {max_tries} tries: {last}")
+
+
+def extract(archive, out_dir):
+    """Untar/unzip/gunzip into out_dir (reference: untarFile/gunzipFile in
+    MnistFetcher)."""
+    os.makedirs(out_dir, exist_ok=True)
+    if tarfile.is_tarfile(archive):
+        with tarfile.open(archive) as t:
+            t.extractall(out_dir, filter="data")
+    elif zipfile.is_zipfile(archive):
+        with zipfile.ZipFile(archive) as z:
+            z.extractall(out_dir)
+    elif archive.endswith(".gz"):
+        out = os.path.join(out_dir,
+                           os.path.basename(archive)[: -len(".gz")])
+        with gzip.open(archive, "rb") as src, open(out, "wb") as dst:
+            shutil.copyfileobj(src, dst)
+    else:
+        shutil.copy(archive, out_dir)
+    return out_dir
+
+
+def download_and_extract(url, cache_dir=None, name=None, md5=None,
+                         max_tries=3):
+    """The downloadAndUntar contract: cache the archive under
+    `<cache>/<name>`, extract next to it once, and return the extraction
+    dir. Subsequent calls are no-ops (cache hit)."""
+    cache_dir = cache_dir or DEFAULT_CACHE
+    name = name or os.path.basename(url.split("?")[0])
+    archive = os.path.join(cache_dir, name)
+    out_dir = archive + ".extracted"
+    marker = os.path.join(out_dir, ".complete")
+    if os.path.exists(marker):
+        return out_dir
+    download_file(url, archive, md5=md5, max_tries=max_tries)
+    extract(archive, out_dir)
+    with open(marker, "w") as f:
+        f.write("ok")
+    return out_dir

@@ -28,18 +28,28 @@ _CACHE = {}
 def _read_idx_images(path):
     op = gzip.open if path.endswith(".gz") else open
     with op(path, "rb") as f:
-        magic, n, rows, cols = struct.unpack(">IIII", f.read(16))
-        assert magic == 2051, f"bad magic {magic}"
-        data = np.frombuffer(f.read(n * rows * cols), dtype=np.uint8)
-        return data.reshape(n, rows, cols)
+        raw = f.read()
+    from ... import native
+    arr = native.idx_read(raw)  # native decoder (dl4jtpu_io.cpp); None = absent
+    if arr is not None and arr.ndim == 3:
+        return arr
+    magic, n, rows, cols = struct.unpack(">IIII", raw[:16])
+    assert magic == 2051, f"bad magic {magic}"
+    data = np.frombuffer(raw, dtype=np.uint8, count=n * rows * cols, offset=16)
+    return data.reshape(n, rows, cols)
 
 
 def _read_idx_labels(path):
     op = gzip.open if path.endswith(".gz") else open
     with op(path, "rb") as f:
-        magic, n = struct.unpack(">II", f.read(8))
-        assert magic == 2049, f"bad magic {magic}"
-        return np.frombuffer(f.read(n), dtype=np.uint8)
+        raw = f.read()
+    from ... import native
+    arr = native.idx_read(raw)
+    if arr is not None and arr.ndim == 1:
+        return arr
+    magic, n = struct.unpack(">II", raw[:8])
+    assert magic == 2049, f"bad magic {magic}"
+    return np.frombuffer(raw, dtype=np.uint8, count=n, offset=8)
 
 
 def _find_mnist_files(train):

@@ -263,6 +263,7 @@ class AsyncDataSetIterator(DataSetIterator):
         self._peek = None
         self._done = False
         self._consumed = False
+        self._pending_error = None
         self._fill_peek()
 
     def _fill_peek(self):
@@ -270,10 +271,11 @@ class AsyncDataSetIterator(DataSetIterator):
             return
         v = self._queue.get()
         if v is self._SENTINEL:
-            if self._error:
-                raise self._error
+            # exhausted; a worker error is held until the already-prefetched
+            # batch is delivered, then surfaced from has_next()
             self._done = True
             self._peek = None
+            self._pending_error = self._error
         else:
             self._peek = v
 
@@ -284,6 +286,10 @@ class AsyncDataSetIterator(DataSetIterator):
         return v
 
     def has_next(self):
+        if self._done and self._pending_error is not None:
+            err = self._pending_error
+            self._pending_error = None
+            raise err
         return not self._done
 
     def reset(self):
@@ -291,7 +297,11 @@ class AsyncDataSetIterator(DataSetIterator):
             return  # fresh iterator: reset is a no-op, keep the prefetched data
         if self._thread is not None and self._thread.is_alive():
             self._stop.set()
-            self._thread.join(timeout=5)
+            self._thread.join(timeout=60)
+            if self._thread.is_alive():
+                raise RuntimeError(
+                    "AsyncDataSetIterator worker did not stop within 60s; "
+                    "cannot safely reset the underlying iterator")
         self.underlying.reset()
         self._start()
 
@@ -362,6 +372,7 @@ class DevicePrefetchIterator(DataSetIterator):
         self._peek = None
         self._done = False
         self._consumed = False
+        self._pending_error = None
         self._fill_peek()
 
     def _fill_peek(self):
@@ -369,13 +380,14 @@ class DevicePrefetchIterator(DataSetIterator):
             return
         v = self._queue.get()
         if v is self._SENTINEL:
+            # mark exhausted: the worker is dead, so a caller that catches a
+            # raised error and polls has_next()/next() again must not block
+            # forever on an empty queue. A worker error is NOT raised here —
+            # the already-prefetched batch in _peek must be delivered first;
+            # has_next() surfaces the error afterwards.
             self._done = True
             self._peek = None
-            if self._error:
-                # mark exhausted BEFORE raising: the worker is dead, so a
-                # caller that catches this and polls has_next()/next() again
-                # must not block forever on an empty queue
-                raise self._error
+            self._pending_error = self._error
         else:
             self._peek = v
 
@@ -386,6 +398,10 @@ class DevicePrefetchIterator(DataSetIterator):
         return v
 
     def has_next(self):
+        if self._done and self._pending_error is not None:
+            err = self._pending_error
+            self._pending_error = None
+            raise err
         return not self._done
 
     def batch(self):
@@ -393,10 +409,17 @@ class DevicePrefetchIterator(DataSetIterator):
 
     def reset(self):
         if not self._consumed and not self._done:
-            return
+            return  # fresh iterator: keep the prefetched data
         if self._thread is not None and self._thread.is_alive():
             self._stop.set()
-            self._thread.join(timeout=5)
+            # the worker may legitimately block for a while inside a large
+            # device_put; resetting underneath it would race the shared
+            # iterator cursor, so wait — and fail loudly rather than corrupt
+            self._thread.join(timeout=60)
+            if self._thread.is_alive():
+                raise RuntimeError(
+                    "DevicePrefetchIterator worker did not stop within 60s; "
+                    "cannot safely reset the underlying iterator")
         self.underlying.reset()
         self._start()
 

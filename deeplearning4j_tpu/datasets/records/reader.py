@@ -66,6 +66,21 @@ class CSVRecordReader(RecordReader):
         self._i = 0
 
     def initialize(self, path):
+        # fast path: the native parser handles plain numeric CSV (the common
+        # ML case) without the Python csv module; it returns None for quoted
+        # or non-numeric content, which falls back to the general parser
+        # (native/src/dl4jtpu_io.cpp dl4j_csv_parse)
+        from ... import native
+        with open(path, "rb") as fb:
+            raw = fb.read()
+        mat = native.csv_parse(raw, self.delimiter, self.skip_lines) \
+            if len(self.delimiter) == 1 else None
+        if mat is not None:
+            self._rows = [row.tolist() for row in mat]
+            self._native = True
+            self._i = 0
+            return self
+        self._native = False
         with open(path, newline="") as f:
             rows = list(csv.reader(f, delimiter=self.delimiter,
                                    quotechar=self.quotechar))
@@ -79,6 +94,8 @@ class CSVRecordReader(RecordReader):
     def next_record(self):
         row = self._rows[self._i]
         self._i += 1
+        if getattr(self, "_native", False):
+            return list(row)  # native parser already produced floats
         return [_coerce(v) for v in row]
 
     def reset(self):

@@ -1,0 +1,126 @@
+"""Keras backend gateway: train/predict a Keras-format model over HTTP.
+
+Reference: deeplearning4j-keras (340 LoC) — Server.java:18 starts a py4j
+GatewayServer; DeepLearning4jEntryPoint.fit() reads a Keras h5 model
+(NeuralNetworkReader), iterates HDF5 minibatch files, and runs
+MultiLayerNetwork.fit per epoch; the Python side is a thin Keras backend
+shim calling these entry points.
+
+TPU redesign: py4j (JVM<->Python bridge) is unnecessary — the gateway is a
+plain HTTP server (stdlib, like streaming/serve.py) with the same entry-point
+contract:
+  POST /models            h5 bytes -> {"model_id"}          (Keras 1.x import)
+  POST /models/<id>/fit   {"features", "labels", "epochs", "batch_size"}
+                          (arrays via streaming.serde envelopes)
+  POST /models/<id>/predict {"features"} -> predictions
+  GET  /models/<id>       -> {"n_params", "iterations_fit"}
+"""
+from __future__ import annotations
+
+import json
+import re
+import tempfile
+import threading
+
+import numpy as np
+
+from .keras import KerasModelImport
+from ..streaming.serde import deserialize_array
+from ..util.http import BackgroundHttpServer, QuietHandler
+
+
+class KerasGatewayServer(BackgroundHttpServer):
+    def __init__(self, port=0, host="127.0.0.1"):
+        super().__init__(host=host, port=port)
+        self.models = {}
+        self._fit_counts = {}
+        self._next_id = 0
+        self._lock = threading.Lock()
+
+    # ------------------------------------------------------------ entry points
+    def register_model(self, h5_bytes: bytes) -> str:
+        """(reference: NeuralNetworkReader.readNeuralNetwork)"""
+        import os
+        with tempfile.NamedTemporaryFile(suffix=".h5", delete=False) as f:
+            f.write(h5_bytes)
+            path = f.name
+        try:
+            net = KerasModelImport.import_keras_sequential_model_and_weights(
+                path, enforce_training_config=True)
+        finally:
+            os.unlink(path)
+        with self._lock:
+            mid = f"model_{self._next_id}"
+            self._next_id += 1
+            self.models[mid] = net
+            self._fit_counts[mid] = 0
+        return mid
+
+    def fit(self, mid, features, labels, epochs=1, batch_size=32):
+        """(reference: DeepLearning4jEntryPoint.fit — N epochs over the
+        minibatched arrays)"""
+        from ..datasets.dataset import DataSet
+        from ..datasets.iterator.base import ListDataSetIterator
+        net = self.models[mid]
+        ds = DataSet(np.asarray(features, np.float32),
+                     np.asarray(labels, np.float32))
+        it = ListDataSetIterator(ds, batch_size=int(batch_size))
+        net.fit(it, epochs=int(epochs))
+        self._fit_counts[mid] += int(epochs)
+        return {"epochs_fit": self._fit_counts[mid],
+                "score": float(net.score_value)}
+
+    def predict(self, mid, features):
+        net = self.models[mid]
+        return np.asarray(net.output(np.asarray(features, np.float32)))
+
+    # ---------------------------------------------------------------- server
+    def start(self):
+        gw = self
+        route = re.compile(r"^/models/([\w-]+)(/fit|/predict)?$")
+
+        class Handler(QuietHandler):
+            _send = QuietHandler.send_json
+            _body = QuietHandler.body
+
+            def do_GET(self):
+                m = route.match(self.path)
+                if m and not m.group(2):
+                    mid = m.group(1)
+                    if mid not in gw.models:
+                        self._send(404, {"error": "unknown model"})
+                        return
+                    net = gw.models[mid]
+                    self._send(200, {"model_id": mid,
+                                     "n_params": int(net.num_params()),
+                                     "epochs_fit": gw._fit_counts[mid]})
+                else:
+                    self._send(404, {"error": "not found"})
+
+            def do_POST(self):
+                try:
+                    if self.path == "/models":
+                        mid = gw.register_model(self._body())
+                        self._send(200, {"model_id": mid})
+                        return
+                    m = route.match(self.path)
+                    if not m or m.group(1) not in gw.models:
+                        self._send(404, {"error": "unknown model"})
+                        return
+                    mid, action = m.group(1), m.group(2)
+                    d = json.loads(self._body())
+                    feats = deserialize_array(d["features"])
+                    if action == "/fit":
+                        out = gw.fit(mid, feats, deserialize_array(d["labels"]),
+                                     d.get("epochs", 1), d.get("batch_size", 32))
+                        self._send(200, out)
+                    elif action == "/predict":
+                        preds = gw.predict(mid, feats)
+                        self._send(200, {"prediction": preds.tolist(),
+                                         "shape": list(preds.shape)})
+                    else:
+                        self._send(404, {"error": "not found"})
+                except Exception as e:
+                    self._send(400, {"error": f"{type(e).__name__}: {e}"})
+
+        return self.start_with(Handler)

@@ -389,6 +389,16 @@ def export_keras_sequential(net, path):
     f.attrs["keras_version"] = np.bytes_(b"1.2.2")
     f.attrs["model_config"] = np.bytes_(json.dumps(
         {"class_name": "Sequential", "config": keras_layers}).encode())
+    # training_config so a re-import can FIT, not just predict: the last
+    # layer's loss maps back to the Keras name (inverse of _KERAS_LOSSES)
+    last = net.conf.layers[-1]
+    loss = getattr(last, "loss", None)
+    if loss is not None:
+        inv_losses = {v: k for k, v in _KERAS_LOSSES.items()}
+        f.attrs["training_config"] = np.bytes_(json.dumps({
+            "loss": inv_losses.get(loss, loss),
+            "optimizer": {"class_name": "SGD", "config": {}},
+        }).encode())
     maxlen = max(len(k) for k in weight_groups) + 1
     f.attrs["layer_names"] = np.array(
         [k.encode() for k in weight_groups], dtype=f"S{maxlen}")

@@ -1,0 +1,37 @@
+"""Build the native IO runtime: g++ -O3 -shared -fPIC -> libdl4jtpu_io.so.
+
+Run as `python -m deeplearning4j_tpu.native.build` or let
+`deeplearning4j_tpu.native.load()` build lazily on first use.
+"""
+from __future__ import annotations
+
+import os
+import subprocess
+import sys
+
+_HERE = os.path.dirname(os.path.abspath(__file__))
+SRC = os.path.join(_HERE, "src", "dl4jtpu_io.cpp")
+LIB = os.path.join(_HERE, "libdl4jtpu_io.so")
+
+
+def build(force=False):
+    """Compile the shared library if missing or stale. Returns the .so path,
+    or None when no C++ toolchain is available."""
+    if not force and os.path.exists(LIB) and \
+            os.path.getmtime(LIB) >= os.path.getmtime(SRC):
+        return LIB
+    cmd = ["g++", "-O3", "-shared", "-fPIC", "-std=c++17", "-pthread",
+           SRC, "-o", LIB]
+    try:
+        subprocess.run(cmd, check=True, capture_output=True)
+    except FileNotFoundError:
+        return None  # no g++ on this machine; Python fallbacks stay active
+    except subprocess.CalledProcessError as e:
+        raise RuntimeError(
+            f"native build failed:\n{e.stderr.decode()}") from e
+    return LIB
+
+
+if __name__ == "__main__":
+    out = build(force="--force" in sys.argv)
+    print(out or "no C++ toolchain found; skipped")

@@ -1,0 +1,160 @@
+// Native host-side IO runtime: CSV parsing, IDX (MNIST) decoding, batch
+// assembly and pixel normalization.
+//
+// Reference analog: the external DataVec library + libnd4j host-side helpers
+// the DL4J layer depends on (SURVEY.md L0/§2.9 — the reference's data path is
+// native via nd4j/JavaCPP; RecordReaderDataSetIterator feeds the accelerator
+// from natively parsed records). This library plays that role for the TPU
+// build: the Python layer (datasets/records/*) keeps the contract, and when
+// this .so is present the hot parsing/assembly loops run here instead of the
+// Python interpreter. Exposed as a plain C ABI consumed via ctypes (the
+// environment has no pybind11).
+//
+// Build: python -m deeplearning4j_tpu.native.build  (g++ -O3 -shared -fPIC)
+
+#include <cstdint>
+#include <cstdlib>
+#include <cstring>
+#include <cstdio>
+#include <atomic>
+#include <thread>
+#include <vector>
+
+extern "C" {
+
+// ---------------------------------------------------------------- CSV -----
+// Parse a numeric CSV buffer into a dense float64 matrix (row-major).
+// Supports a single-char delimiter, optional lines to skip, blank-line
+// tolerance. Returns 0 on success; fills *out_rows/*out_cols and writes into
+// caller-provided `out` when non-null (two-phase: first call with out=null to
+// size, then with the allocated buffer). Values are float64 so parity with
+// the Python float() path is exact. Non-numeric or empty fields fail with -2
+// (the Python caller falls back to its general quote-aware parser).
+int dl4j_csv_parse(const char* buf, int64_t len, char delim, int64_t skip,
+                   double* out, int64_t* out_rows, int64_t* out_cols) {
+    int64_t rows = 0, cols = -1;
+    int64_t i = 0;
+    // skip leading lines
+    for (int64_t s = 0; s < skip && i < len; ++s) {
+        while (i < len && buf[i] != '\n') ++i;
+        if (i < len) ++i;
+    }
+    int64_t write = 0;
+    while (i < len) {
+        // skip blank lines
+        if (buf[i] == '\n' || buf[i] == '\r') { ++i; continue; }
+        int64_t line_cols = 0;
+        while (i < len && buf[i] != '\n') {
+            // parse one field
+            char* end = nullptr;
+            // strtod stops at delimiter/newline; give it a bounded view by
+            // relying on the delimiter not being numeric
+            double v = strtod(buf + i, &end);
+            if (end == buf + i) return -2;  // non-numeric field
+            if (out) out[write] = v;
+            ++write;
+            ++line_cols;
+            i = end - buf;
+            while (i < len && buf[i] == '\r') ++i;
+            if (i < len && buf[i] == delim) {
+                ++i;
+                // a trailing delimiter means an empty final field — the
+                // Python csv module keeps it; defer to that parser
+                if (i >= len || buf[i] == '\n' || buf[i] == '\r') return -2;
+            } else {
+                break;
+            }
+        }
+        if (i < len && buf[i] == '\n') ++i;
+        if (line_cols > 0) {
+            if (cols == -1) cols = line_cols;
+            else if (cols != line_cols) return -3;  // ragged rows
+            ++rows;
+        }
+    }
+    *out_rows = rows;
+    *out_cols = cols < 0 ? 0 : cols;
+    return 0;
+}
+
+// ---------------------------------------------------------------- IDX -----
+// Decode the IDX format (MNIST images/labels). Returns 0 on success and
+// fills dims (up to 4); `out` sized by the product of dims, written as uint8.
+int dl4j_idx_info(const uint8_t* buf, int64_t len, int64_t* dims,
+                  int32_t* ndim) {
+    if (len < 4 || buf[0] != 0 || buf[1] != 0) return -1;
+    if (buf[2] != 0x08) return -2;  // only uint8 payloads (MNIST)
+    int n = buf[3];
+    if (n < 1 || n > 4 || len < 4 + 4 * n) return -3;
+    for (int d = 0; d < n; ++d) {
+        const uint8_t* p = buf + 4 + 4 * d;
+        dims[d] = ((int64_t)p[0] << 24) | ((int64_t)p[1] << 16)
+                | ((int64_t)p[2] << 8) | (int64_t)p[3];
+    }
+    *ndim = n;
+    return 0;
+}
+
+int dl4j_idx_read(const uint8_t* buf, int64_t len, uint8_t* out,
+                  int64_t out_len) {
+    int64_t dims[4];
+    int32_t nd;
+    int rc = dl4j_idx_info(buf, len, dims, &nd);
+    if (rc != 0) return rc;
+    int64_t total = 1;
+    for (int d = 0; d < nd; ++d) total *= dims[d];
+    if (total > out_len || 4 + 4 * nd + total > len) return -4;
+    memcpy(out, buf + 4 + 4 * nd, total);
+    return 0;
+}
+
+// ------------------------------------------------------- batch assembly ---
+// Gather `batch` rows of `row_elems` f32 elements from `src` at `indices`
+// into a contiguous batch buffer — the shuffle-gather hot loop of
+// RecordReaderDataSetIterator / MagicQueue, parallelized across threads.
+void dl4j_gather_rows_f32(const float* src, const int64_t* indices,
+                          int64_t batch, int64_t row_elems, float* out,
+                          int32_t n_threads) {
+    if (n_threads < 1) n_threads = 1;
+    if (n_threads == 1 || batch < 64) {
+        for (int64_t b = 0; b < batch; ++b)
+            memcpy(out + b * row_elems, src + indices[b] * row_elems,
+                   row_elems * sizeof(float));
+        return;
+    }
+    std::vector<std::thread> ts;
+    std::atomic<int64_t> next(0);
+    for (int32_t t = 0; t < n_threads; ++t) {
+        ts.emplace_back([&]() {
+            int64_t b;
+            while ((b = next.fetch_add(1)) < batch)
+                memcpy(out + b * row_elems, src + indices[b] * row_elems,
+                       row_elems * sizeof(float));
+        });
+    }
+    for (auto& th : ts) th.join();
+}
+
+// uint8 pixels -> f32 in [min_range, max_range] (host-side fallback of the
+// on-device ImageScalerPreProcessor for CPU-bound pipelines)
+void dl4j_normalize_u8_f32(const uint8_t* src, int64_t n, float min_range,
+                           float max_range, float* out) {
+    const float scale = (max_range - min_range) / 255.0f;
+    for (int64_t i = 0; i < n; ++i)
+        out[i] = (float)src[i] * scale + min_range;
+}
+
+// one-hot encode int labels into a zeroed f32 matrix [n, n_classes]
+int dl4j_one_hot_f32(const int64_t* labels, int64_t n, int64_t n_classes,
+                     float* out) {
+    memset(out, 0, (size_t)(n * n_classes) * sizeof(float));
+    for (int64_t i = 0; i < n; ++i) {
+        if (labels[i] < 0 || labels[i] >= n_classes) return -1;
+        out[i * n_classes + labels[i]] = 1.0f;
+    }
+    return 0;
+}
+
+int dl4j_io_version() { return 1; }
+
+}  // extern "C"

@@ -1,0 +1,93 @@
+"""P8: distributed (sharded) Word2Vec training over a device mesh.
+
+Reference: deeplearning4j-scaleout/spark/dl4j-spark-nlp/.../word2vec/
+Word2Vec.java:61 + FirstIterationFunction.java — two-phase Spark word2vec:
+the driver broadcasts vocab + syn0/syn1, executors train partitions of the
+corpus, results are averaged. TPU-native redesign: no driver/executor split —
+the (center, context) pair stream is sharded over the mesh's data axis and
+the embedding tables stay replicated; GSPMD turns the per-shard scatter-adds
+into an all-reduce, which IS parameter averaging with averaging window = 1
+batch (the limit the reference approximates). Optionally the tables
+themselves shard row-wise over the model axis for vocabularies too large for
+one chip's HBM (no reference counterpart — new capability).
+"""
+from __future__ import annotations
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from .sharding import make_mesh, DATA_AXIS, MODEL_AXIS
+from ..nlp.sequence_vectors import Word2Vec
+
+
+class SpmdWord2Vec(Word2Vec):
+    """Word2Vec whose training batches are sharded over a Mesh data axis.
+
+    Same builder surface as Word2Vec plus `mesh`/`shard_tables`:
+        SpmdWord2Vec(mesh=make_mesh(n_data=8), layer_size=64, ...)
+    shard_tables=True additionally partitions syn0/syn1 rows over the model
+    axis (set n_model > 1 in the mesh).
+    """
+
+    def __init__(self, mesh=None, shard_tables=False, **kw):
+        super().__init__(**kw)
+        self.mesh = mesh if mesh is not None else make_mesh()
+        self.shard_tables = bool(shard_tables)
+
+    # ---------------------------------------------------------- placement
+    def _table_sharding(self):
+        if self.shard_tables and self.mesh.shape[MODEL_AXIS] > 1:
+            return NamedSharding(self.mesh, P(MODEL_AXIS, None))
+        return NamedSharding(self.mesh, P())
+
+    def _batch_sharding(self):
+        return NamedSharding(self.mesh, P(DATA_AXIS))
+
+    def build_vocab(self, sentences):
+        super().build_vocab(sentences)
+        lt = self.lookup_table
+        ts = self._table_sharding()
+        n_model = self.mesh.shape[MODEL_AXIS] if self.shard_tables else 1
+
+        def place(tab):
+            if tab is None:
+                return None
+            pad = (-tab.shape[0]) % n_model  # row-sharding needs even rows
+            if pad:
+                tab = jnp.concatenate(
+                    [tab, jnp.zeros((pad, tab.shape[1]), tab.dtype)])
+            return jax.device_put(tab, ts)
+
+        lt.syn0 = place(lt.syn0)
+        lt.syn1 = place(lt.syn1)
+        if getattr(lt, "syn1neg", None) is not None:
+            lt.syn1neg = place(lt.syn1neg)
+        if getattr(lt, "_unigram", None) is not None:
+            lt._unigram = jax.device_put(lt._unigram,
+                                         NamedSharding(self.mesh, P()))
+        return self
+
+    def _pad_chunk(self, *arrays):
+        """Pad to a multiple of CHUNK x data-axis and shard over the batch
+        dim, so every device holds an equal slice of the pair stream."""
+        from ..nlp.embeddings import CHUNK
+        n_data = self.mesh.shape[DATA_AXIS]
+        B = len(arrays[0])
+        mult = int(np.lcm(CHUNK, n_data))
+        Ppad = (-B) % mult
+        valid = np.ones(B + Ppad, np.float32)
+        valid[B:] = 0.0
+        bs = self._batch_sharding()
+        out = []
+        for a in arrays:
+            a = np.asarray(a)
+            if Ppad:
+                a = np.concatenate([a, np.zeros((Ppad,) + a.shape[1:], a.dtype)])
+            out.append(jax.device_put(a, bs))
+        return out + [jax.device_put(valid, bs)]
+
+    def _train_batch(self, centers, contexts, lr):
+        with self.mesh:
+            super()._train_batch(centers, contexts, lr)

@@ -88,6 +88,7 @@ class ServeRoute:
         self._stop = threading.Event()
         self._thread = None
         self.processed = 0
+        self.errors = []
 
     def _drain_batch(self):
         msgs = []
@@ -107,16 +108,36 @@ class ServeRoute:
             msgs = self._drain_batch()
             if not msgs:
                 continue
-            batch = np.concatenate([m.array for m in msgs], axis=0)
-            if self.transform is not None:
-                batch = self.transform(batch)
-            preds = np.asarray(self.model.output(batch))
-            off = 0
-            for m in msgs:
-                n = m.array.shape[0]
-                self.sink.publish(NDArrayMessage(preds[off:off + n], m.meta))
-                off += n
-            self.processed += len(msgs)
+            published = 0
+            try:
+                batch = np.concatenate([m.array for m in msgs], axis=0)
+                if self.transform is not None:
+                    batch = self.transform(batch)
+                preds = np.asarray(self.model.output(batch))
+                off = 0
+                for m in msgs:
+                    n = m.array.shape[0]
+                    self.sink.publish(NDArrayMessage(preds[off:off + n],
+                                                     m.meta))
+                    off += n
+                    published += 1
+                self.processed += len(msgs)
+            except Exception as e:
+                # a bad record must not kill the route: report error
+                # envelopes for the messages that did NOT get a prediction
+                # out (no duplicates for already-published ones) and keep
+                # consuming (the Camel route's dead-letter behavior). Error
+                # records are stored as strings, bounded, so a persistent
+                # failure stream can't pin batches/tracebacks in memory.
+                if len(self.errors) < 100:
+                    self.errors.append(f"{type(e).__name__}: {e}")
+                try:
+                    for m in msgs[published:]:
+                        self.sink.publish(NDArrayMessage(
+                            np.zeros((0,), np.float32),
+                            dict(m.meta, error=f"{type(e).__name__}: {e}")))
+                except Exception:
+                    pass  # the sink itself is down; nothing more to report to
 
     def start(self):
         self._stop.clear()

@@ -2,8 +2,8 @@
 
 Reference: dl4j-streaming routes/DL4jServeRouteBuilder.java:56-105 (the
 "serve" leg of the route: record in -> model.output -> prediction out).
-Transport is stdlib http.server like ui/server.py (zero-egress friendly);
-the hot path is the model's cached jitted `output`.
+Transport is the shared stdlib plumbing (util/http.py); the hot path is the
+model's cached jitted `output`.
 
 Endpoints:
   POST /predict     body = {"data": nested list} or serde envelope
@@ -13,25 +13,20 @@ Endpoints:
 from __future__ import annotations
 
 import json
-import threading
-from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 
 import numpy as np
 
-from .serde import deserialize_array, serialize_array
+from .serde import deserialize_array
+from ..util.http import BackgroundHttpServer, QuietHandler
 
 
-class InferenceServer:
+class InferenceServer(BackgroundHttpServer):
     def __init__(self, model, port=0, host="127.0.0.1", transform=None):
+        super().__init__(host=host, port=port)
         self.model = model
-        self.host = host
-        self.port = int(port)
         self.transform = transform
-        self._httpd = None
-        self._thread = None
         self.served = 0
 
-    # ------------------------------------------------------------ handlers
     def _predict(self, body: bytes):
         d = json.loads(body)
         if "dtype" in d and "shape" in d:  # serde envelope (streaming.serde)
@@ -44,52 +39,24 @@ class InferenceServer:
         self.served += x.shape[0]
         return {"prediction": out.tolist(), "shape": list(out.shape)}
 
-    # ------------------------------------------------------------ lifecycle
     def start(self):
         server = self
 
-        class Handler(BaseHTTPRequestHandler):
-            def log_message(self, *a):
-                pass
-
-            def _send(self, status, obj):
-                payload = json.dumps(obj).encode()
-                self.send_response(status)
-                self.send_header("Content-Type", "application/json")
-                self.send_header("Content-Length", str(len(payload)))
-                self.end_headers()
-                self.wfile.write(payload)
-
+        class Handler(QuietHandler):
             def do_GET(self):
                 if self.path == "/healthz":
-                    self._send(200, {"status": "ok", "served": server.served})
+                    self.send_json(200, {"status": "ok",
+                                         "served": server.served})
                 else:
-                    self._send(404, {"error": "not found"})
+                    self.send_json(404, {"error": "not found"})
 
             def do_POST(self):
                 if self.path != "/predict":
-                    self._send(404, {"error": "not found"})
+                    self.send_json(404, {"error": "not found"})
                     return
                 try:
-                    n = int(self.headers.get("Content-Length", 0))
-                    body = self.rfile.read(n)
-                    self._send(200, server._predict(body))
+                    self.send_json(200, server._predict(self.body()))
                 except Exception as e:  # surface errors as JSON, keep serving
-                    self._send(400, {"error": f"{type(e).__name__}: {e}"})
+                    self.send_json(400, {"error": f"{type(e).__name__}: {e}"})
 
-        self._httpd = ThreadingHTTPServer((self.host, self.port), Handler)
-        self.port = self._httpd.server_address[1]
-        self._thread = threading.Thread(target=self._httpd.serve_forever,
-                                        daemon=True)
-        self._thread.start()
-        return self
-
-    def stop(self):
-        if self._httpd is not None:
-            self._httpd.shutdown()
-            self._httpd.server_close()
-            self._httpd = None
-
-    @property
-    def url(self):
-        return f"http://{self.host}:{self.port}"
+        return self.start_with(Handler)

@@ -65,6 +65,13 @@ class FaultTolerantTrainer:
                 out.append(name)
         return sorted(out, key=lambda n: int(n.split("-")[1]))
 
+    def _gc_orphans(self):
+        import shutil
+        for name in os.listdir(self.ckpt.directory):
+            if name.startswith("tmp-"):
+                shutil.rmtree(os.path.join(self.ckpt.directory, name),
+                              ignore_errors=True)
+
     def checkpoint(self):
         """Write an atomic checkpoint of model + training state."""
         it = self.state["iteration"]
@@ -94,8 +101,13 @@ class FaultTolerantTrainer:
         for name in dirs[:-self.ckpt.keep_last]:
             shutil.rmtree(os.path.join(self.ckpt.directory, name),
                           ignore_errors=True)
+        # orphaned tmp-* dirs are half-written checkpoints from a process
+        # that was preempted mid-write; this (single-writer) driver owns the
+        # directory, so any tmp-* present outside checkpoint() is garbage
+        self._gc_orphans()
 
     def _try_restore(self):
+        self._gc_orphans()
         dirs = self._ckpt_dirs()
         if not dirs:
             self.model = self._factory()

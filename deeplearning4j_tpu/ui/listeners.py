@@ -1,0 +1,103 @@
+"""Legacy render listeners: convolutional activations + flow view.
+
+Reference: deeplearning4j-ui/.../weights/ConvolutionalIterationListener.java
+(renders per-channel activation tiles of conv layers every N iterations) and
+flow/FlowIterationListener.java (pushes the network-structure view). The Play
+rendering stack is replaced by JSON posts into the StatsStorage router; the
+matching UI modules (ui/server.py ConvolutionalModule / FlowModule) serve the
+latest payloads.
+"""
+from __future__ import annotations
+
+import time
+
+import numpy as np
+
+
+class ConvolutionalIterationListener:
+    """Every `frequency` iterations, run the model forward on a reference
+    batch and publish normalized uint8 activation grids for every 4-D (NHWC)
+    activation (reference: ConvolutionalIterationListener.java)."""
+
+    def __init__(self, storage_router, reference_input, frequency=10,
+                 session_id=None, max_channels=16):
+        self.router = storage_router
+        self.x = np.asarray(reference_input)[:1]  # first example only
+        self.frequency = max(1, int(frequency))
+        self.session_id = session_id or f"conv_{int(time.time() * 1000)}"
+        self.max_channels = int(max_channels)
+
+    def on_epoch_start(self, model):
+        pass
+
+    def on_epoch_end(self, model):
+        pass
+
+    def record_batch_size(self, b):
+        pass
+
+    def iteration_done(self, model, iteration):
+        if iteration % self.frequency != 0:
+            return
+        layers = {}
+        acts = self._collect(model)
+        for name, a in acts.items():
+            a = np.asarray(a)
+            if a.ndim != 4:
+                continue
+            grid = a[0]  # [h, w, c]
+            c = min(grid.shape[-1], self.max_channels)
+            chans = []
+            for i in range(c):
+                g = grid[..., i]
+                lo, hi = float(g.min()), float(g.max())
+                scale = 255.0 / (hi - lo) if hi > lo else 0.0
+                chans.append(((g - lo) * scale).astype(np.uint8).tolist())
+            layers[name] = {"height": int(grid.shape[0]),
+                            "width": int(grid.shape[1]),
+                            "channels": chans}
+        self.router.put_update({
+            "type": "activations",
+            "session_id": self.session_id,
+            "iteration": iteration,
+            "time": time.time(),
+            "layers": layers,
+        })
+
+    def _collect(self, model):
+        """Activation map per layer/vertex name on the reference input."""
+        from ..nn.multilayer.network import MultiLayerNetwork
+        x = self.x.astype(np.float32)
+        if isinstance(model, MultiLayerNetwork):
+            _, _, _, _, collected = model._forward(
+                model.params, model.states, x, train=False, rng=None,
+                collect=True)
+            return {str(i): a for i, a in enumerate(collected)}
+        return dict(model.feed_forward(x))
+
+
+class FlowIterationListener:
+    """Publishes the network-structure (flow) snapshot through the stats
+    router so the FlowModule can serve it (reference:
+    flow/FlowIterationListener.java)."""
+
+    def __init__(self, storage_router, frequency=10, session_id=None):
+        from .stats import StatsListener
+        self._inner = StatsListener(storage_router, frequency=frequency,
+                                    session_id=session_id,
+                                    collect_params=False,
+                                    collect_gradients=False,
+                                    collect_memory=False)
+        self.wants_gradients = False
+
+    def on_epoch_start(self, model):
+        pass
+
+    def on_epoch_end(self, model):
+        pass
+
+    def record_batch_size(self, b):
+        pass
+
+    def iteration_done(self, model, iteration):
+        self._inner.iteration_done(model, iteration)

@@ -13,9 +13,8 @@ chart on a <canvas> (no external assets; zero-egress friendly).
 from __future__ import annotations
 
 import json
-import threading
-from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 
+from ..util.http import BackgroundHttpServer, QuietHandler
 from .storage import InMemoryStatsStorage
 
 
@@ -90,6 +89,129 @@ class TrainModule(UIModule):
         })
 
 
+class HistogramModule(UIModule):
+    """Weight/gradient histograms + mean-magnitude time series (reference:
+    module/histogram/HistogramModule.java — the /weights page data API)."""
+
+    def __init__(self):
+        self.storage = None
+
+    def on_attach(self, storage):
+        self.storage = storage
+
+    def routes(self):
+        return {("GET", "/weights/data"): self._data}
+
+    def _data(self, query, body):
+        sid = query.get("sid")
+        ids = self.storage.list_session_ids()
+        if sid is None and ids:
+            sid = ids[-1]
+        updates = [u for u in (self.storage.get_all_updates(sid) if sid else [])
+                   if u.get("type") != "activations"]
+        latest = updates[-1] if updates else {}
+        series = {}
+        for u in updates:
+            for name, st in (u.get("param_stats") or {}).items():
+                series.setdefault(name, []).append(st.get("mean_magnitude"))
+        payload = {
+            "session": sid,
+            "iteration": latest.get("iteration"),
+            "param_histograms": {n: {"bins": st.get("histogram"),
+                                     "range": st.get("histogram_edges")}
+                                 for n, st in (latest.get("param_stats") or {}).items()},
+            "gradient_histograms": {n: {"bins": st.get("histogram"),
+                                        "range": st.get("histogram_edges")}
+                                    for n, st in (latest.get("gradient_stats") or {}).items()},
+            "mean_magnitudes": series,
+            "scores": [u.get("score") for u in updates],
+        }
+        return 200, "application/json", json.dumps(payload).encode()
+
+
+class FlowModule(UIModule):
+    """Network-structure (flow) view data (reference:
+    module/flow/FlowListenerModule.java + FlowIterationListener — nodes/edges
+    of the layer graph plus per-layer perf from the latest update)."""
+
+    def __init__(self):
+        self.storage = None
+
+    def on_attach(self, storage):
+        self.storage = storage
+
+    def routes(self):
+        return {("GET", "/flow/info"): self._info}
+
+    def _info(self, query, body):
+        sid = query.get("sid")
+        ids = self.storage.list_session_ids()
+        if sid is None and ids:
+            sid = ids[-1]
+        static = self.storage.get_static_info(sid) if sid else None
+        stats = [u for u in (self.storage.get_all_updates(sid) if sid else [])
+                 if u.get("type") != "activations"]
+        latest = stats[-1] if stats else None
+        return 200, "application/json", json.dumps({
+            "session": sid,
+            "graph": (static or {}).get("graph", {"nodes": [], "edges": []}),
+            "score": (latest or {}).get("score"),
+            "iteration": (latest or {}).get("iteration"),
+        }).encode()
+
+
+class ConvolutionalModule(UIModule):
+    """Convolutional activation render data (reference:
+    module/convolutional/ConvolutionalListenerModule.java +
+    ConvolutionalIterationListener — the listener posts normalized uint8
+    activation grids; this serves the latest one per layer)."""
+
+    def __init__(self):
+        self.storage = None
+
+    def on_attach(self, storage):
+        self.storage = storage
+
+    def routes(self):
+        return {("GET", "/activations/data"): self._data}
+
+    def _data(self, query, body):
+        sid = query.get("sid")
+        ids = self.storage.list_session_ids()
+        if sid is None and ids:
+            sid = ids[-1]
+        updates = self.storage.get_all_updates(sid) if sid else []
+        for u in reversed(updates):
+            if u.get("type") == "activations":
+                return 200, "application/json", json.dumps(u).encode()
+        return 200, "application/json", json.dumps(
+            {"session": sid, "layers": {}}).encode()
+
+
+class TsneModule(UIModule):
+    """t-SNE coordinate serving (reference: module/tsne/TsneModule.java —
+    upload/serve word coordinate files). POST /tsne/upload a JSON
+    {"words": [...], "coords": [[x,y],...]}; GET /tsne/coords returns it."""
+
+    def __init__(self):
+        self._payload = {"words": [], "coords": []}
+
+    def routes(self):
+        return {("POST", "/tsne/upload"): self._upload,
+                ("GET", "/tsne/coords"): self._coords}
+
+    def _upload(self, query, body):
+        d = json.loads(body)
+        if "words" not in d or "coords" not in d:
+            return 400, "application/json", b'{"error":"need words+coords"}'
+        self._payload = {"words": list(d["words"]),
+                         "coords": [list(map(float, c)) for c in d["coords"]]}
+        return 200, "application/json", b'{"status":"ok"}'
+
+    def _coords(self, query, body):
+        return 200, "application/json", json.dumps(self._payload).encode()
+
+
 class RemoteReceiverModule(UIModule):
     """Accepts POSTed reports from RemoteUIStatsStorageRouter (reference:
     module/remote/RemoteReceiverModule.java)."""
@@ -112,21 +234,21 @@ class RemoteReceiverModule(UIModule):
         return 200, "application/json", b'{"status":"ok"}'
 
 
-class UIServer:
+class UIServer(BackgroundHttpServer):
     """(reference: PlayUIServer — getInstance().attach(statsStorage))"""
 
     _instance = None
 
     def __init__(self, port=9000, modules=None):
-        self.port = port
+        super().__init__(host="127.0.0.1", port=port)
         self.storage = None
         self.modules = modules or [DefaultModule(), TrainModule(),
+                                   HistogramModule(), FlowModule(),
+                                   ConvolutionalModule(), TsneModule(),
                                    RemoteReceiverModule()]
         self._routes = {}
         for m in self.modules:
             self._routes.update(m.routes())
-        self._httpd = None
-        self._thread = None
 
     @classmethod
     def get_instance(cls, port=9000):
@@ -146,10 +268,7 @@ class UIServer:
             self.attach(InMemoryStatsStorage())
         routes = self._routes
 
-        class Handler(BaseHTTPRequestHandler):
-            def log_message(self, *a):  # silence request logging
-                pass
-
+        class Handler(QuietHandler):
             def _dispatch(self, method):
                 from urllib.parse import urlparse, parse_qs
                 u = urlparse(self.path)
@@ -174,23 +293,12 @@ class UIServer:
             def do_POST(self):
                 self._dispatch("POST")
 
-        self._httpd = ThreadingHTTPServer(("127.0.0.1", self.port), Handler)
-        self.port = self._httpd.server_port  # resolves port=0 to the real one
-        self._thread = threading.Thread(target=self._httpd.serve_forever,
-                                        daemon=True)
-        self._thread.start()
-        return self
+        return self.start_with(Handler)
 
     def stop(self):
-        if self._httpd:
-            self._httpd.shutdown()
-            self._httpd.server_close()
+        super().stop()
         if UIServer._instance is self:
             UIServer._instance = None
-
-    @property
-    def url(self):
-        return f"http://127.0.0.1:{self.port}"
 
 
 _INDEX_HTML = b"""<!doctype html>

@@ -34,7 +34,32 @@ class StatsInitReport:
             "n_params": int(model.num_params()) if model.params is not None else 0,
             "model_class": type(model).__name__,
             "pid": os.getpid(),
+            "graph": self._graph_info(model),
         }
+
+    @staticmethod
+    def _graph_info(model):
+        """Layer/vertex topology for the flow (network-structure) UI module
+        (reference: FlowIterationListener builds this from the model)."""
+        try:
+            conf = model.conf
+            if hasattr(conf, "vertices"):  # ComputationGraph
+                nodes, edges = [], []
+                for name in model.order:
+                    spec = conf.vertices[name]
+                    kind = (type(spec.layer_conf).__name__ if spec.kind == "layer"
+                            else type(spec.vertex_conf).__name__
+                            if spec.kind == "vertex" else "Input")
+                    nodes.append({"name": name, "type": kind})
+                    for src in (spec.inputs or []):
+                        edges.append([src, name])
+                return {"nodes": nodes, "edges": edges}
+            nodes = [{"name": str(i), "type": type(lc).__name__}
+                     for i, lc in enumerate(conf.layers)]
+            edges = [[str(i), str(i + 1)] for i in range(len(nodes) - 1)]
+            return {"nodes": nodes, "edges": edges}
+        except Exception:
+            return {"nodes": [], "edges": []}
 
     def to_json(self):
         return json.dumps(self.data)

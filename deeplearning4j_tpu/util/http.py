@@ -1,0 +1,67 @@
+"""Shared stdlib HTTP-server plumbing for the JSON endpoints.
+
+One lifecycle implementation for the three servers (streaming/serve.py,
+modelimport/gateway.py, ui/server.py): ThreadingHTTPServer on a daemon
+thread, port-0 resolution, shutdown/close, and JSON response writing.
+"""
+from __future__ import annotations
+
+import json
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+
+def send_json(handler: BaseHTTPRequestHandler, status: int, obj) -> None:
+    payload = json.dumps(obj).encode()
+    handler.send_response(status)
+    handler.send_header("Content-Type", "application/json")
+    handler.send_header("Content-Length", str(len(payload)))
+    handler.end_headers()
+    handler.wfile.write(payload)
+
+
+def read_body(handler: BaseHTTPRequestHandler) -> bytes:
+    n = int(handler.headers.get("Content-Length", 0))
+    return handler.rfile.read(n) if n else b""
+
+
+class QuietHandler(BaseHTTPRequestHandler):
+    """Base handler with request logging silenced and the JSON helpers."""
+
+    def log_message(self, *a):
+        pass
+
+    def send_json(self, status, obj):
+        send_json(self, status, obj)
+
+    def body(self):
+        return read_body(self)
+
+
+class BackgroundHttpServer:
+    """Owns the ThreadingHTTPServer lifecycle; subclass-or-compose with a
+    handler class (usually a QuietHandler subclass closing over the owner)."""
+
+    def __init__(self, host="127.0.0.1", port=0):
+        self.host = host
+        self.port = int(port)
+        self._httpd = None
+        self._thread = None
+
+    def start_with(self, handler_cls):
+        self._httpd = ThreadingHTTPServer((self.host, self.port), handler_cls)
+        self.port = self._httpd.server_address[1]
+        self._thread = threading.Thread(target=self._httpd.serve_forever,
+                                        daemon=True)
+        self._thread.start()
+        return self
+
+    def stop(self):
+        if self._httpd is not None:
+            self._httpd.shutdown()
+            self._httpd.server_close()
+            self._httpd = None
+
+    @property
+    def url(self):
+        return f"http://{self.host}:{self.port}"

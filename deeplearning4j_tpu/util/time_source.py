@@ -1,0 +1,108 @@
+"""Time sources for cross-node stats timestamps.
+
+Reference: spark/dl4j-spark/.../time/{TimeSource.java, TimeSourceProvider.java,
+NTPTimeSource.java, SystemClockTimeSource.java} — Spark stats events are
+stamped with NTP-corrected wall time so phase timings line up across nodes.
+
+TPU redesign: same SPI. NTPTimeSource implements the SNTP (RFC 4330) client
+exchange over UDP; in the zero-egress build environment the query fails and
+the source falls back to the system clock with offset 0 (recorded in
+`last_error`) — the offset arithmetic is exercised in tests by injecting a
+fake response. TimeSourceProvider mirrors the reference's singleton +
+system-property override with an env var.
+"""
+from __future__ import annotations
+
+import os
+import socket
+import struct
+import time
+
+_NTP_EPOCH_DELTA = 2208988800  # seconds between 1900 (NTP) and 1970 (unix)
+
+
+class TimeSource:
+    def current_time_millis(self) -> int:
+        raise NotImplementedError
+
+
+class SystemClockTimeSource(TimeSource):
+    """(reference: time/SystemClockTimeSource.java)"""
+
+    def current_time_millis(self):
+        return int(time.time() * 1000)
+
+
+class NTPTimeSource(TimeSource):
+    """(reference: time/NTPTimeSource.java — queries an NTP server every
+    `update_frequency_ms` and applies the measured offset to wall time)."""
+
+    DEFAULT_SERVER = "0.pool.ntp.org"
+
+    def __init__(self, server=None, timeout=2.0, update_frequency_ms=1800000):
+        self.server = server or os.environ.get("DL4J_TPU_NTP_SERVER",
+                                               self.DEFAULT_SERVER)
+        self.timeout = float(timeout)
+        self.update_frequency_ms = int(update_frequency_ms)
+        self.offset_ms = 0
+        self.last_error = None
+        self._last_update = 0.0
+        self._maybe_update()
+
+    @staticmethod
+    def _parse_offset_ms(packet, t_send, t_recv):
+        """SNTP offset = ((T2 - T1) + (T3 - T4)) / 2 (RFC 4330)."""
+        if len(packet) < 48:
+            raise ValueError("short NTP packet")
+        sec2, frac2 = struct.unpack("!II", packet[32:40])   # receive ts
+        sec3, frac3 = struct.unpack("!II", packet[40:48])   # transmit ts
+        t2 = sec2 - _NTP_EPOCH_DELTA + frac2 / 2 ** 32
+        t3 = sec3 - _NTP_EPOCH_DELTA + frac3 / 2 ** 32
+        return ((t2 - t_send) + (t3 - t_recv)) / 2 * 1000.0
+
+    def _query(self):
+        pkt = bytearray(48)
+        pkt[0] = 0x1B  # LI=0, VN=3, mode=3 (client)
+        with socket.socket(socket.AF_INET, socket.SOCK_DGRAM) as s:
+            s.settimeout(self.timeout)
+            t_send = time.time()
+            s.sendto(bytes(pkt), (self.server, 123))
+            data, _ = s.recvfrom(512)
+            t_recv = time.time()
+        return self._parse_offset_ms(data, t_send, t_recv)
+
+    def _maybe_update(self):
+        now = time.time()
+        if (now - self._last_update) * 1000 < self.update_frequency_ms and \
+                self._last_update > 0:
+            return
+        self._last_update = now
+        try:
+            self.offset_ms = self._query()
+            self.last_error = None
+        except (OSError, ValueError) as e:
+            # no egress / timeout / malformed reply: system clock fallback
+            self.last_error = e
+
+    def current_time_millis(self):
+        self._maybe_update()
+        return int(time.time() * 1000 + self.offset_ms)
+
+
+class TimeSourceProvider:
+    """(reference: time/TimeSourceProvider.java — singleton chosen by system
+    property; here the DL4J_TPU_TIMESOURCE env var: 'ntp' or 'system')."""
+
+    _instance = None
+
+    @classmethod
+    def get_instance(cls) -> TimeSource:
+        if cls._instance is None:
+            kind = os.environ.get("DL4J_TPU_TIMESOURCE", "system").lower()
+            cls._instance = (NTPTimeSource() if kind == "ntp"
+                             else SystemClockTimeSource())
+        return cls._instance
+
+    @classmethod
+    def reset(cls):
+        cls._instance = None

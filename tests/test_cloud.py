@@ -1,0 +1,139 @@
+"""Cloud module tests (reference: deeplearning4j-aws — S3 blob IO + EC2
+provisioning; exercised hermetically through the local backends)."""
+import os
+
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu.cloud import (LocalBlobStore, BlobDataSetIterator,
+                                      get_blob_store, ClusterSetup,
+                                      HostProvisioner, LocalTransport)
+from deeplearning4j_tpu.datasets.dataset import DataSet
+
+
+def test_blob_store_roundtrip(tmp_path):
+    store = LocalBlobStore(tmp_path / "bucket")
+    store.upload_bytes(b"hello", "models/a.bin")
+    store.upload_bytes(b"world", "models/b.bin")
+    store.upload_bytes(b"x", "other/c.bin")
+    assert store.download_bytes("models/a.bin") == b"hello"
+    assert store.list_keys("models/") == ["models/a.bin", "models/b.bin"]
+    local = tmp_path / "dl" / "a.bin"
+    store.download("models/a.bin", local)
+    assert open(local, "rb").read() == b"hello"
+    store.delete("models/b.bin")
+    assert store.list_keys("models/") == ["models/a.bin"]
+    with pytest.raises(ValueError):
+        store.download_bytes("../escape")
+
+
+def test_get_blob_store_resolution(tmp_path):
+    s = get_blob_store(f"file://{tmp_path}/b1")
+    assert isinstance(s, LocalBlobStore)
+    s2 = get_blob_store(str(tmp_path / "b2"))
+    assert isinstance(s2, LocalBlobStore)
+    with pytest.raises((ImportError, NotImplementedError)):
+        get_blob_store("s3://bucket")
+
+
+def test_blob_dataset_iterator_trains(tmp_path):
+    """DataSets stored as blobs feed fit() (reference:
+    BaseS3DataSetIterator)."""
+    from deeplearning4j_tpu import (NeuralNetConfiguration, InputType,
+                                    DenseLayer, OutputLayer,
+                                    MultiLayerNetwork, Sgd)
+    store = LocalBlobStore(tmp_path / "ds")
+    rng = np.random.default_rng(0)
+    w = rng.normal(size=(6, 3))
+    for i in range(4):
+        X = rng.normal(size=(16, 6)).astype(np.float32)
+        Y = np.eye(3, dtype=np.float32)[np.argmax(X @ w, 1)]
+        BlobDataSetIterator.save_dataset(store, f"train/batch_{i}.npz",
+                                         DataSet(X, Y))
+    it = BlobDataSetIterator(store, "train/")
+    conf = (NeuralNetConfiguration.builder().seed(1).updater(Sgd(0.1)).list()
+            .layer(DenseLayer(n_out=16, activation="relu"))
+            .layer(OutputLayer(n_out=3, activation="softmax", loss="MCXENT"))
+            .input_type(InputType.feed_forward(6))
+            .build())
+    net = MultiLayerNetwork(conf).init()
+    net.fit(it, epochs=3)
+    assert net.iteration_count == 12
+
+
+def test_cluster_setup_local_transport(tmp_path):
+    hosts = ["worker0", "worker1", "worker2"]
+    # sandboxed per-host filesystems: concurrent uploads of the same logical
+    # remote path must not collide
+    cs = ClusterSetup(hosts, LocalTransport(sandbox_root=tmp_path / "hosts"))
+    outs = cs.run_on_all("echo provisioned-$USER")
+    assert set(outs) == set(hosts)
+    assert all("provisioned" in o for o in outs.values())
+
+    script = tmp_path / "setup.sh"
+    script.write_text("echo bootstrap-ok\n")
+    outs = cs.bootstrap(str(script), remote_path="/tmp/setup.sh")
+    assert all("bootstrap-ok" in o for o in outs.values())
+    for h in hosts:
+        assert os.path.exists(tmp_path / "hosts" / h / "tmp" / "setup.sh")
+
+
+def test_host_provisioner_retries():
+    class Flaky(LocalTransport):
+        def __init__(self):
+            self.calls = 0
+
+        def run(self, host, command, timeout=300):
+            self.calls += 1
+            if self.calls < 3:
+                return 1, "", "transient"
+            return super().run(host, command, timeout)
+
+    t = Flaky()
+    p = HostProvisioner(t, "h1", retries=3)
+    out = p.run("echo ok")
+    assert "ok" in out and t.calls == 3
+
+    t2 = Flaky()
+    p2 = HostProvisioner(t2, "h1", retries=2)  # not enough retries
+    with pytest.raises(RuntimeError, match="rc=1"):
+        p2.run("echo ok")
+
+
+# -------------------------------------------- download + cache machinery
+
+def test_download_file_retry_and_checksum(tmp_path):
+    import hashlib
+    from deeplearning4j_tpu.datasets.fetchers.download import download_file
+    src = tmp_path / "payload.bin"
+    src.write_bytes(b"A" * 1000)
+    md5 = hashlib.md5(b"A" * 1000).hexdigest()
+    url = src.as_uri()
+    dest = tmp_path / "cache" / "payload.bin"
+    assert download_file(url, dest, md5=md5) == str(dest)
+    assert dest.read_bytes() == b"A" * 1000
+    # cache hit: deleting the source must not matter
+    src.unlink()
+    assert download_file(url, dest, md5=md5) == str(dest)
+    # checksum mismatch fails after bounded retries
+    src2 = tmp_path / "other.bin"
+    src2.write_bytes(b"B")
+    with pytest.raises(IOError, match="after 2 tries"):
+        download_file(src2.as_uri(), tmp_path / "cache" / "o.bin",
+                      md5="0" * 32, max_tries=2, backoff_s=0)
+
+
+def test_download_and_extract_tar(tmp_path):
+    import tarfile
+    from deeplearning4j_tpu.datasets.fetchers.download import download_and_extract
+    inner = tmp_path / "data.txt"
+    inner.write_text("mnist-like-content")
+    tar = tmp_path / "dataset.tar.gz"
+    with tarfile.open(tar, "w:gz") as t:
+        t.add(inner, arcname="data.txt")
+    out = download_and_extract(tar.as_uri(), cache_dir=str(tmp_path / "cache"))
+    assert open(os.path.join(out, "data.txt")).read() == "mnist-like-content"
+    # second call is a pure cache hit (archive source can disappear)
+    tar.unlink()
+    out2 = download_and_extract(tar.as_uri(), cache_dir=str(tmp_path / "cache"))
+    assert out2 == out

@@ -294,3 +294,97 @@ def test_quadtree_structure_and_forces():
     # approximate forces stay close
     neg_a, sum_qa = qt.compute_non_edge_forces(p, theta=0.5)
     assert abs(sum_qa - q.sum()) / q.sum() < 0.1
+
+
+def test_keras_gateway_server(tmp_path):
+    """HTTP gateway serving the Keras-backend entry points (reference:
+    deeplearning4j-keras Server.java + DeepLearning4jEntryPoint.fit)."""
+    import json as _json
+    import urllib.request
+    import numpy as np
+    from deeplearning4j_tpu.modelimport.gateway import KerasGatewayServer
+    from deeplearning4j_tpu.streaming.serde import serialize_array
+    from deeplearning4j_tpu.modelimport import hdf5_lite
+
+    # build a small Keras-1.x h5 (same layout the importer reads)
+    rng = np.random.default_rng(4)
+    W1 = rng.normal(size=(4, 8), scale=0.4).astype(np.float32)
+    b1 = np.zeros(8, np.float32)
+    W2 = rng.normal(size=(8, 3), scale=0.4).astype(np.float32)
+    b2 = np.zeros(3, np.float32)
+    cfg = {"class_name": "Sequential", "config": [
+        {"class_name": "Dense", "config": {
+            "name": "d1", "output_dim": 8, "activation": "tanh",
+            "batch_input_shape": [None, 4]}},
+        {"class_name": "Dense", "config": {
+            "name": "d2", "output_dim": 3, "activation": "softmax"}}]}
+    f = hdf5_lite.H5File()
+    f.attrs["keras_version"] = np.bytes_(b"1.2.2")
+    f.attrs["model_config"] = np.bytes_(_json.dumps(cfg).encode())
+    f.attrs["training_config"] = np.bytes_(_json.dumps(
+        {"loss": "categorical_crossentropy",
+         "optimizer": {"class_name": "SGD", "config": {"lr": 0.1}}}).encode())
+    f.attrs["layer_names"] = np.array([b"d1", b"d2"], dtype="S4")
+    for name, W, b in (("d1", W1, b1), ("d2", W2, b2)):
+        g = f.create_group(name)
+        g.attrs["weight_names"] = np.array(
+            [f"{name}_W".encode(), f"{name}_b".encode()], dtype="S8")
+        g.create_dataset(f"{name}_W", W)
+        g.create_dataset(f"{name}_b", b)
+    h5p = tmp_path / "gw.h5"
+    f.save(h5p)
+
+    srv = KerasGatewayServer(port=0).start()
+    try:
+        def post(path, data, raw=False):
+            req = urllib.request.Request(srv.url + path, data=data)
+            with urllib.request.urlopen(req, timeout=60) as r:
+                return _json.loads(r.read())
+
+        mid = post("/models", open(h5p, "rb").read())["model_id"]
+        X = rng.normal(size=(64, 4)).astype(np.float32)
+        w = rng.normal(size=(4, 3))
+        Y = np.eye(3, dtype=np.float32)[np.argmax(X @ w, 1)]
+        out1 = post(f"/models/{mid}/fit", _json.dumps(
+            {"features": _json.loads(serialize_array(X)),
+             "labels": _json.loads(serialize_array(Y)),
+             "epochs": 5, "batch_size": 16}).encode())
+        assert out1["epochs_fit"] == 5
+        pred = post(f"/models/{mid}/predict", _json.dumps(
+            {"features": _json.loads(serialize_array(X))}).encode())
+        assert pred["shape"] == [64, 3]
+        p = np.asarray(pred["prediction"])
+        np.testing.assert_allclose(p.sum(1), 1.0, rtol=1e-4)
+        with urllib.request.urlopen(srv.url + f"/models/{mid}", timeout=10) as r:
+            info = _json.loads(r.read())
+        assert info["n_params"] == 4*8 + 8 + 8*3 + 3
+    finally:
+        srv.stop()
+
+
+def test_time_sources():
+    from deeplearning4j_tpu.util.time_source import (SystemClockTimeSource,
+                                                     NTPTimeSource,
+                                                     TimeSourceProvider)
+    import struct, time as _time
+    s = SystemClockTimeSource()
+    assert abs(s.current_time_millis() - _time.time() * 1000) < 2000
+
+    # offset arithmetic from a crafted SNTP packet: server clock 5s ahead
+    t = _time.time()
+    ahead = t + 5.0
+    sec = int(ahead) + 2208988800
+    frac = int((ahead % 1) * 2**32)
+    pkt = bytearray(48)
+    pkt[32:40] = struct.pack("!II", sec, frac)   # receive ts (T2)
+    pkt[40:48] = struct.pack("!II", sec, frac)   # transmit ts (T3)
+    off = NTPTimeSource._parse_offset_ms(bytes(pkt), t, t)
+    assert 4800 < off < 5200
+
+    # zero-egress env: construction must not raise, falls back to system time
+    src = NTPTimeSource(server="192.0.2.1", timeout=0.2)  # TEST-NET, no route
+    assert abs(src.current_time_millis() - _time.time() * 1000) < 5000
+
+    TimeSourceProvider.reset()
+    assert isinstance(TimeSourceProvider.get_instance(), SystemClockTimeSource)
+    TimeSourceProvider.reset()

@@ -112,3 +112,94 @@ def test_components_serde():
     assert rebuilt.to_dict() == d
     hist = components.ChartHistogram(title="h").add_bin(0, 1, 5).add_bin(1, 2, 3)
     assert hist.to_dict()["bins"][1] == {"lower": 1.0, "upper": 2.0, "y": 3.0}
+
+
+def test_histogram_flow_conv_tsne_modules():
+    """The four UI modules beyond the train page (reference:
+    module/{histogram,flow,convolutional,tsne}/*.java) serve real data."""
+    import json as _json
+    import urllib.request
+    import numpy as np
+    from deeplearning4j_tpu import (NeuralNetConfiguration, InputType,
+                                    ConvolutionLayer, OutputLayer,
+                                    MultiLayerNetwork, DataSet, Sgd)
+    from deeplearning4j_tpu.ui.listeners import (ConvolutionalIterationListener,
+                                                 FlowIterationListener)
+
+    conf = (NeuralNetConfiguration.builder().seed(2).updater(Sgd(0.1)).list()
+            .layer(ConvolutionLayer(kernel_size=(3, 3), n_out=4,
+                                    activation="relu", convolution_mode="same"))
+            .layer(OutputLayer(n_out=3, activation="softmax", loss="MCXENT"))
+            .input_type(InputType.convolutional(6, 6, 1))
+            .build())
+    net = MultiLayerNetwork(conf).init()
+    storage = InMemoryStatsStorage()
+    rng = np.random.default_rng(0)
+    x = rng.random((8, 6, 6, 1)).astype(np.float32)
+    y = np.eye(3, dtype=np.float32)[rng.integers(0, 3, 8)]
+    net.set_listeners(StatsListener(storage, frequency=1, session_id="m1"),
+                      ConvolutionalIterationListener(storage, x, frequency=1,
+                                                     session_id="m1"))
+    for _ in range(3):
+        net.fit_batch(DataSet(x, y))
+
+    server = UIServer(port=0).attach(storage).start()
+    base = f"http://127.0.0.1:{server.port}"
+    try:
+        def get(path):
+            with urllib.request.urlopen(base + path, timeout=30) as r:
+                return _json.loads(r.read())
+
+        h = get("/weights/data?sid=m1")
+        assert h["param_histograms"], "no param histograms served"
+        some = next(iter(h["param_histograms"].values()))
+        assert len(some["bins"]) == 20
+        assert any(v for v in h["mean_magnitudes"].values())
+
+        f = get("/flow/info?sid=m1")
+        names = [n["name"] for n in f["graph"]["nodes"]]
+        assert names == ["0", "1"]
+        assert f["graph"]["edges"] == [["0", "1"]]
+        assert f["score"] is not None
+
+        a = get("/activations/data?sid=m1")
+        assert a["layers"], "no activation grids served"
+        lay = next(iter(a["layers"].values()))
+        assert lay["height"] == 6 and lay["width"] == 6
+        assert len(lay["channels"]) >= 1
+        flat = np.asarray(lay["channels"][0])
+        assert flat.shape == (6, 6) and flat.max() <= 255
+
+        # t-SNE module: upload then serve
+        req = urllib.request.Request(
+            base + "/tsne/upload",
+            data=_json.dumps({"words": ["a", "b"],
+                              "coords": [[0.0, 1.0], [2.0, 3.0]]}).encode())
+        with urllib.request.urlopen(req, timeout=10) as r:
+            assert _json.loads(r.read())["status"] == "ok"
+        t = get("/tsne/coords")
+        assert t["words"] == ["a", "b"] and t["coords"][1] == [2.0, 3.0]
+    finally:
+        server.stop()
+
+
+def test_flow_iteration_listener_publishes_graph():
+    import numpy as np
+    from deeplearning4j_tpu import (NeuralNetConfiguration, InputType,
+                                    DenseLayer, OutputLayer,
+                                    MultiLayerNetwork, DataSet, Sgd)
+    from deeplearning4j_tpu.ui.listeners import FlowIterationListener
+    conf = (NeuralNetConfiguration.builder().seed(2).updater(Sgd(0.1)).list()
+            .layer(DenseLayer(n_out=4, activation="relu"))
+            .layer(OutputLayer(n_out=2, activation="softmax", loss="MCXENT"))
+            .input_type(InputType.feed_forward(3))
+            .build())
+    net = MultiLayerNetwork(conf).init()
+    storage = InMemoryStatsStorage()
+    net.set_listeners(FlowIterationListener(storage, frequency=1,
+                                            session_id="fl1"))
+    x = np.random.default_rng(1).random((4, 3)).astype(np.float32)
+    y = np.eye(2, dtype=np.float32)[[0, 1, 0, 1]]
+    net.fit_batch(DataSet(x, y))
+    st = storage.get_static_info("fl1")
+    assert st["graph"]["nodes"][0]["type"] == "DenseLayer"
