@@ -46,8 +46,11 @@ class LocalBlobStore(BlobStore):
         os.makedirs(self.root, exist_ok=True)
 
     def _path(self, key):
-        p = os.path.normpath(os.path.join(self.root, key))
-        if not p.startswith(os.path.normpath(self.root)):
+        root = os.path.normpath(self.root)
+        p = os.path.normpath(os.path.join(root, key))
+        # prefix check must be boundary-aware: '/data/store2' shares the raw
+        # string prefix of root '/data/store' but is OUTSIDE it
+        if p != root and not p.startswith(root + os.sep):
             raise ValueError(f"key escapes the store root: {key}")
         return p
 

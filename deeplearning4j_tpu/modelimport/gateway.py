@@ -58,21 +58,25 @@ class KerasGatewayServer(BackgroundHttpServer):
 
     def fit(self, mid, features, labels, epochs=1, batch_size=32):
         """(reference: DeepLearning4jEntryPoint.fit — N epochs over the
-        minibatched arrays)"""
+        minibatched arrays). Serialized under the gateway lock: the HTTP
+        server is threaded and concurrent fit/predict on one model would race
+        on its parameters."""
         from ..datasets.dataset import DataSet
         from ..datasets.iterator.base import ListDataSetIterator
-        net = self.models[mid]
-        ds = DataSet(np.asarray(features, np.float32),
-                     np.asarray(labels, np.float32))
-        it = ListDataSetIterator(ds, batch_size=int(batch_size))
-        net.fit(it, epochs=int(epochs))
-        self._fit_counts[mid] += int(epochs)
-        return {"epochs_fit": self._fit_counts[mid],
-                "score": float(net.score_value)}
+        with self._lock:
+            net = self.models[mid]
+            ds = DataSet(np.asarray(features, np.float32),
+                         np.asarray(labels, np.float32))
+            it = ListDataSetIterator(ds, batch_size=int(batch_size))
+            net.fit(it, epochs=int(epochs))
+            self._fit_counts[mid] += int(epochs)
+            return {"epochs_fit": self._fit_counts[mid],
+                    "score": float(net.score_value)}
 
     def predict(self, mid, features):
-        net = self.models[mid]
-        return np.asarray(net.output(np.asarray(features, np.float32)))
+        with self._lock:
+            net = self.models[mid]
+            return np.asarray(net.output(np.asarray(features, np.float32)))
 
     # ---------------------------------------------------------------- server
     def start(self):

@@ -51,10 +51,15 @@ int dl4j_csv_parse(const char* buf, int64_t len, char delim, int64_t skip,
             // relying on the delimiter not being numeric
             double v = strtod(buf + i, &end);
             if (end == buf + i) return -2;  // non-numeric field
+            i = end - buf;
+            // the number must be followed by a delimiter/EOL: a field like
+            // "1 2" (internal whitespace) is a STRING to the Python parser
+            // and must defer, not silently split into two values
+            if (i < len && buf[i] != delim && buf[i] != '\n' && buf[i] != '\r')
+                return -2;
             if (out) out[write] = v;
             ++write;
             ++line_cols;
-            i = end - buf;
             while (i < len && buf[i] == '\r') ++i;
             if (i < len && buf[i] == delim) {
                 ++i;

@@ -502,10 +502,12 @@ class ComputationGraph:
         self.listeners = resolve_listeners(listeners)
         return self
 
-    def evaluate(self, iterator):
+    def evaluate(self, iterator, top_n=1):
+        """top_n > 1 also tracks top-N accuracy (reference:
+        MultiLayerNetwork.evaluate(iter, labels, topN))."""
         from ...eval.evaluation import Evaluation
         from ...datasets.iterator.base import as_iterator
-        e = Evaluation()
+        e = Evaluation(top_n=top_n)
         it = as_iterator(iterator)
         it.reset()
         for ds in it:

@@ -127,6 +127,13 @@ class ParameterAveragingTrainingMaster(TrainingMaster):
 
         from ..nn.multilayer.network import MultiLayerNetwork
         is_mln = isinstance(model, MultiLayerNetwork)
+        from ..nn.conf.configuration import BackpropType
+        if getattr(model.conf, "backprop_type", None) == BackpropType.TRUNCATED_BPTT:
+            import warnings
+            warnings.warn(
+                "averaging mode trains replicas with full-sequence BPTT; the "
+                "model's TRUNCATED_BPTT window is not applied here (train "
+                "with ShardedTrainer/fit for TBPTT semantics)", stacklevel=3)
         step = model._get_train_step("std")
 
         # replicate: stack params/opt_state/states on a leading replica axis

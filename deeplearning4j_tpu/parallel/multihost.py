@@ -1,0 +1,91 @@
+"""Multi-host (multi-process) distributed bootstrap.
+
+Reference: the reference scales out through Spark driver↔executor RPC
+(ParameterAveragingTrainingMaster.java:344-378) and the Aeron parameter
+server — a user-space control+data plane. TPU-native redesign (SURVEY.md
+§2.5): the data plane is XLA collectives over ICI/DCN inside the compiled
+step; the only host-side piece left is process bootstrap, which
+`jax.distributed` provides. This module wraps it with the mesh helpers so a
+multi-host data/tensor-parallel job is:
+
+    from deeplearning4j_tpu.parallel import multihost, sharding
+    multihost.initialize(coordinator="host0:1234", num_processes=N,
+                         process_id=i)           # once per process
+    mesh = multihost.global_mesh(n_model=2)      # all processes' devices
+    trainer = sharding.ShardedTrainer(net, mesh=mesh)
+    trainer.fit(iterator)                        # per-process data shards
+
+Every process runs the same program (SPMD); `process_batch_slice` maps a
+global batch index range onto this process so input pipelines feed only the
+local shard (the multi-host analog of the reference's executor partitions).
+"""
+from __future__ import annotations
+
+import numpy as np
+import jax
+
+from .sharding import make_mesh
+
+_initialized = False
+
+
+def initialize(coordinator=None, num_processes=None, process_id=None,
+               local_device_ids=None):
+    """Bootstrap jax.distributed (no-op for single-process jobs when no
+    coordinator is given). Mirrors jax.distributed.initialize but records
+    state so helpers below can answer topology questions without the caller
+    tracking them."""
+    global _initialized
+    if coordinator is None:
+        return  # single-process no-op; must NOT block a later real init
+    if _initialized:
+        return
+    jax.distributed.initialize(coordinator_address=coordinator,
+                               num_processes=num_processes,
+                               process_id=process_id,
+                               local_device_ids=local_device_ids)
+    _initialized = True
+
+
+def process_count():
+    return jax.process_count()
+
+
+def process_index():
+    return jax.process_index()
+
+
+def global_mesh(n_model=1, n_seq=1):
+    """Mesh over ALL processes' devices (jax.devices() is global after
+    distributed init); data axis spans what's left after model/seq."""
+    return make_mesh(n_model=n_model, n_seq=n_seq, devices=jax.devices())
+
+
+def local_device_count():
+    return jax.local_device_count()
+
+
+def process_batch_slice(global_batch):
+    """[start, end) of the global batch this process should load — the input
+    pipeline analog of the reference's balancedRandomSplit partitioning
+    (SparkUtils.java); data is sharded evenly by process."""
+    n = jax.process_count()
+    i = jax.process_index()
+    per = global_batch // n
+    extra = global_batch % n
+    start = i * per + min(i, extra)
+    end = start + per + (1 if i < extra else 0)
+    return start, end
+
+
+def host_local_to_global(arrays, mesh, specs):
+    """Assemble per-process host arrays into one global sharded array (the
+    multi-host device_put: each process contributes its slice). Thin wrapper
+    over jax.make_array_from_process_local_data."""
+    from jax.sharding import NamedSharding
+    out = []
+    for a, spec in zip(arrays, specs):
+        sharding = NamedSharding(mesh, spec)
+        out.append(jax.make_array_from_process_local_data(sharding,
+                                                          np.asarray(a)))
+    return out

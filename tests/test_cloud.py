@@ -137,3 +137,11 @@ def test_download_and_extract_tar(tmp_path):
     tar.unlink()
     out2 = download_and_extract(tar.as_uri(), cache_dir=str(tmp_path / "cache"))
     assert out2 == out
+
+
+def test_blob_store_prefix_sibling_escape_blocked(tmp_path):
+    store = LocalBlobStore(tmp_path / "store")
+    (tmp_path / "store2").mkdir()
+    (tmp_path / "store2" / "secret").write_text("x")
+    with pytest.raises(ValueError):
+        store.download_bytes("../store2/secret")

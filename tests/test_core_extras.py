@@ -388,3 +388,22 @@ def test_time_sources():
     TimeSourceProvider.reset()
     assert isinstance(TimeSourceProvider.get_instance(), SystemClockTimeSource)
     TimeSourceProvider.reset()
+
+
+def test_network_evaluate_top_n():
+    from deeplearning4j_tpu import (NeuralNetConfiguration, InputType,
+                                    DenseLayer, OutputLayer,
+                                    MultiLayerNetwork, DataSet, Sgd,
+                                    ListDataSetIterator)
+    import numpy as np
+    conf = (NeuralNetConfiguration.builder().seed(0).updater(Sgd(0.1)).list()
+            .layer(DenseLayer(n_out=8, activation="tanh"))
+            .layer(OutputLayer(n_out=5, activation="softmax", loss="MCXENT"))
+            .set_input_type(InputType.feed_forward(4))
+            .build())
+    net = MultiLayerNetwork(conf).init()
+    rng = np.random.default_rng(1)
+    X = rng.normal(size=(40, 4)).astype(np.float32)
+    Y = np.eye(5, dtype=np.float32)[rng.integers(0, 5, 40)]
+    e = net.evaluate(ListDataSetIterator(DataSet(X, Y), batch_size=10), top_n=3)
+    assert 0.0 <= e.accuracy() <= e.top_n_accuracy() <= 1.0

@@ -205,3 +205,37 @@ def test_embedding_gradients():
                   OutputLayer(n_out=nout, activation="softmax", loss="MCXENT")],
                  InputType.feed_forward(1))
     assert check_gradients(net, x, y, print_results=True)
+
+
+def test_self_attention_gradients():
+    """Gradient check for the multi-head self-attention layer (new
+    capability; validates the blockwise/reference attention backward)."""
+    from deeplearning4j_tpu import SelfAttentionLayer
+    rng = np.random.default_rng(13)
+    b, t, nin, nout = 2, 5, 3, 2
+    x = rng.normal(size=(b, t, nin))
+    y = _onehot(rng.integers(0, nout, (b, t)).ravel(), nout).reshape(b, t, nout)
+    for causal in (False, True):
+        net = _build([SelfAttentionLayer(n_out=4, n_heads=2,
+                                         activation="identity", causal=causal),
+                      RnnOutputLayer(n_out=nout, activation="softmax",
+                                     loss="MCXENT")],
+                     InputType.recurrent(nin))
+        assert check_gradients(net, x, y, print_results=True), f"causal={causal}"
+
+
+def test_self_attention_masked_gradients():
+    from deeplearning4j_tpu import SelfAttentionLayer
+    import jax.numpy as jnp
+    rng = np.random.default_rng(14)
+    b, t, nin, nout = 2, 5, 3, 2
+    x = rng.normal(size=(b, t, nin))
+    y = _onehot(rng.integers(0, nout, (b, t)).ravel(), nout).reshape(b, t, nout)
+    mask = np.ones((b, t))
+    mask[0, 3:] = 0
+    net = _build([SelfAttentionLayer(n_out=4, n_heads=2, activation="identity"),
+                  RnnOutputLayer(n_out=nout, activation="softmax", loss="MCXENT")],
+                 InputType.recurrent(nin))
+    assert check_gradients(net, x, y, mask=jnp.asarray(mask, jnp.float64),
+                           label_mask=jnp.asarray(mask, jnp.float64),
+                           print_results=True)

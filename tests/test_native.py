@@ -100,3 +100,8 @@ def test_csv_trailing_delimiter_falls_back():
     m = native.csv_parse(b"16777217,0.1\n")
     assert m is not None and m.dtype == np.float64
     assert m[0, 0] == float("16777217") and m[0, 1] == float("0.1")
+
+
+def test_csv_internal_whitespace_falls_back():
+    # "1 2" is a string field to the Python parser; native must defer
+    assert native.csv_parse(b"1 2\n3 4\n") is None

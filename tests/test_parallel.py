@@ -186,3 +186,33 @@ def test_binomial_preprocessor_uses_step_rng():
     # and deterministic for the same key
     c = np.asarray(pre(x, rng=jax.random.PRNGKey(1)))
     np.testing.assert_array_equal(a, c)
+
+
+def test_multihost_helpers_single_process():
+    """Single-process semantics of the multi-host bootstrap helpers (the
+    multi-process path uses the same jax.distributed machinery; here
+    process_count()==1)."""
+    from deeplearning4j_tpu.parallel import multihost
+    from jax.sharding import PartitionSpec as P
+    multihost.initialize()  # no coordinator: single-process no-op
+    assert multihost.process_count() == 1
+    assert multihost.process_index() == 0
+    assert multihost.local_device_count() == 8
+    mesh = multihost.global_mesh(n_model=2)
+    assert mesh.shape["data"] == 4 and mesh.shape["model"] == 2
+
+    # batch slicing covers the global batch exactly, no overlap
+    s, e = multihost.process_batch_slice(37)
+    assert (s, e) == (0, 37)
+
+    # host-local -> global assembly round-trips
+    x = np.arange(64, dtype=np.float32).reshape(8, 8)
+    (gx,) = multihost.host_local_to_global([x], mesh, [P("data", None)])
+    np.testing.assert_array_equal(np.asarray(gx), x)
+
+    # and a sharded train step runs over the assembled global batch
+    net = MultiLayerNetwork(_conf()).init()
+    trainer = ShardedTrainer(net, mesh=make_mesh(n_data=8))
+    X, Y = _toy(n=32)
+    trainer.fit_batch(DataSet(X, Y))
+    assert np.isfinite(net.score_value)
