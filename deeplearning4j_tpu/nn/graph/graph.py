@@ -94,14 +94,15 @@ class ComputationGraph:
         self._build_updater()
         return self
 
-    def _build_updater(self):
+    def _build_updater(self, init_state=True):
         from ..updaters import per_layer_transform
         transforms = {}
         for name in self.params:
             lc = self.conf.vertices[name].layer_conf
             transforms[name] = lc.updater.to_optax() if lc.updater is not None else optax.sgd(0.1)
         self._tx = per_layer_transform(transforms)
-        self.opt_state = self._tx.init(self.params)
+        if init_state:
+            self.opt_state = self._tx.init(self.params)
 
     # -------------------------------------------------------------- forward
     def _forward(self, params, states, inputs, *, train, rng, masks=None,

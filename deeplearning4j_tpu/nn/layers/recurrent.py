@@ -58,10 +58,13 @@ def _lstm_scan(params, x, h0, c0, gate_act, cell_act, peephole, mask=None, rever
     def step(carry, inputs):
         h_prev, c_prev = carry
         if mask is not None:
-            x_t, m_t = inputs
+            xz_t, m_t = inputs
         else:
-            x_t, m_t = inputs, None
-        z = x_t @ W + h_prev @ RW + b  # [b, 4n] one fused gemm
+            xz_t, m_t = inputs, None
+        # the input projection was hoisted out of the scan (one [b*t, n_in]
+        # gemm instead of t small ones — the MXU-friendly schedule); only the
+        # recurrent gemm stays sequential
+        z = xz_t + h_prev @ RW
         zi, zf, zo, zg = (z[:, I * n_out:(I + 1) * n_out], z[:, F * n_out:(F + 1) * n_out],
                           z[:, O * n_out:(O + 1) * n_out], z[:, G * n_out:(G + 1) * n_out])
         if P is not None:
@@ -84,7 +87,8 @@ def _lstm_scan(params, x, h0, c0, gate_act, cell_act, peephole, mask=None, rever
             h_out = h_new
         return (h_new, c_new), h_out
 
-    xs = jnp.swapaxes(x, 0, 1)  # [t, b, n_in]
+    xz_all = x @ W + b                # [b, t, 4n] single batched gemm
+    xs = jnp.swapaxes(xz_all, 0, 1)   # [t, b, 4n]
     seq = (xs, jnp.swapaxes(mask, 0, 1)) if mask is not None else xs
     (h_f, c_f), outs = lax.scan(step, (h0, c0), seq, reverse=reverse)
     return jnp.swapaxes(outs, 0, 1), (h_f, c_f)

@@ -91,7 +91,7 @@ class MultiLayerNetwork:
         self._build_updater()
         return self
 
-    def _build_updater(self):
+    def _build_updater(self, init_state=True):
         """Per-layer optax transforms (each layer may override the updater —
         reference: LayerUpdater per layer, UpdaterCreator)."""
         from ..updaters import per_layer_transform
@@ -99,7 +99,8 @@ class MultiLayerNetwork:
         for i, lc in enumerate(self.conf.layers):
             transforms[str(i)] = lc.updater.to_optax() if lc.updater is not None else optax.sgd(0.1)
         self._tx = per_layer_transform(transforms)
-        self.opt_state = self._tx.init(self.params)
+        if init_state:
+            self.opt_state = self._tx.init(self.params)
 
     # -------------------------------------------------------------- forward
     def _apply_preprocessor(self, i, x, mask, rng=None):

@@ -19,7 +19,6 @@ from __future__ import annotations
 
 import json
 import os
-import tempfile
 
 import numpy as np
 
@@ -27,10 +26,15 @@ from ..util.model_serializer import ModelSerializer
 
 
 class CheckpointConfig:
-    def __init__(self, directory, frequency=50, keep_last=2):
+    def __init__(self, directory, frequency=50, keep_last=2, format="zip"):
+        """format: "zip" (ModelSerializer contract, host-gathered) or
+        "sharded" (orbax tensor store — mesh-sharded params checkpoint
+        without host gathering, util/sharded_checkpoint.py)."""
+        assert format in ("zip", "sharded")
         self.directory = str(directory)
         self.frequency = int(frequency)
         self.keep_last = int(keep_last)
+        self.format = format
 
 
 class FaultTolerantTrainer:
@@ -46,6 +50,7 @@ class FaultTolerantTrainer:
 
     STATE_FILE = "train_state.json"
     MODEL_FILE = "model.zip"
+    SHARDED_DIR = "model_sharded"
 
     def __init__(self, model_or_factory, checkpoint: CheckpointConfig):
         self.ckpt = checkpoint
@@ -78,10 +83,20 @@ class FaultTolerantTrainer:
         final = os.path.join(self.ckpt.directory, f"ckpt-{it:09d}")
         if os.path.isdir(final):
             return final  # this iteration is already durably checkpointed
-        tmp = tempfile.mkdtemp(prefix="tmp-", dir=self.ckpt.directory)
+        # deterministic tmp name so multi-process jobs (sharded format) agree
+        # on the orbax write path; process 0 alone publishes/GCs below
+        import jax
+        tmp = os.path.join(self.ckpt.directory, f"tmp-{it:09d}")
+        os.makedirs(tmp, exist_ok=True)
         try:
-            ModelSerializer.write_model(self.model,
-                                        os.path.join(tmp, self.MODEL_FILE))
+            if self.ckpt.format == "sharded":
+                from ..util.sharded_checkpoint import save_sharded
+                save_sharded(self.model, os.path.join(tmp, self.SHARDED_DIR))
+            else:
+                ModelSerializer.write_model(self.model,
+                                            os.path.join(tmp, self.MODEL_FILE))
+            if jax.process_index() != 0:
+                return final  # process 0 publishes the checkpoint dir
             st = dict(self.state)
             rng = getattr(self.model, "_rng", None)
             st["rng"] = None if rng is None else np.asarray(rng).tolist()
@@ -115,8 +130,13 @@ class FaultTolerantTrainer:
                 self.model.init()
             return False
         latest = os.path.join(self.ckpt.directory, dirs[-1])
-        self.model = ModelSerializer.restore(
-            os.path.join(latest, self.MODEL_FILE))
+        sharded_dir = os.path.join(latest, self.SHARDED_DIR)
+        if os.path.isdir(sharded_dir):
+            from ..util.sharded_checkpoint import restore_sharded
+            self.model = restore_sharded(sharded_dir)
+        else:
+            self.model = ModelSerializer.restore(
+                os.path.join(latest, self.MODEL_FILE))
         with open(os.path.join(latest, self.STATE_FILE)) as f:
             self.state = json.load(f)
         rng = self.state.get("rng")

@@ -1,0 +1,93 @@
+"""Sharded tensor-store checkpoints (orbax) for mesh-sharded models.
+
+Reference: util/ModelSerializer.java's zip contract covers host-side dense
+arrays (kept as `util/model_serializer.py`); SURVEY.md §7 adds a "sharded
+tensor-store format" for the TPU build — parameters that live sharded over a
+Mesh must checkpoint WITHOUT gathering to one host (a TP/FSDP model may not
+fit host memory, and multi-host jobs write in parallel). Orbax handles the
+per-shard IO; this module adds the model plumbing: config JSON next to the
+tensor store (written by process 0 only), an allocation-free restore built
+from jax.eval_shape abstract templates, and resharding-on-restore that
+covers params AND optimizer state (moments inherit the param shardings).
+"""
+from __future__ import annotations
+
+import json
+import os
+
+import jax
+
+
+def save_sharded(model, path):
+    """Write config + params/opt_state/states as an orbax tensor store. Each
+    process writes only its own shards (all processes must call this with
+    the same path; the config JSON is written by process 0 alone)."""
+    import orbax.checkpoint as ocp
+    path = os.path.abspath(str(path))
+    if jax.process_index() == 0:
+        os.makedirs(path, exist_ok=True)
+        with open(os.path.join(path, "configuration.json"), "w") as f:
+            json.dump({"model_class": type(model).__name__,
+                       "conf": model.conf.to_dict()}, f)
+    ckptr = ocp.StandardCheckpointer()
+    state = {"params": model.params, "states": model.states,
+             "opt_state": model.opt_state}
+    ckptr.save(os.path.join(path, "state"), state, force=True)
+    ckptr.wait_until_finished()
+    return path
+
+
+def _build_model(meta):
+    from ..nn.conf.configuration import MultiLayerConfiguration
+    from ..nn.conf.graph_configuration import ComputationGraphConfiguration
+    from ..nn.multilayer.network import MultiLayerNetwork
+    from ..nn.graph.graph import ComputationGraph
+    if meta["model_class"] == "ComputationGraph":
+        return ComputationGraph(
+            ComputationGraphConfiguration.from_dict(meta["conf"]))
+    return MultiLayerNetwork(MultiLayerConfiguration.from_dict(meta["conf"]))
+
+
+def restore_sharded(path, shardings=None):
+    """Rebuild the model from a sharded checkpoint. `shardings`: optional
+    pytree (matching params) of NamedShardings to place the restored state
+    directly onto a mesh (resharding-on-restore); optimizer-state leaves
+    inherit their parameter's sharding, everything else replicates on the
+    same mesh. The template is built with jax.eval_shape — nothing dense is
+    materialized before orbax streams the shards in."""
+    import orbax.checkpoint as ocp
+    path = os.path.abspath(str(path))
+    with open(os.path.join(path, "configuration.json")) as f:
+        meta = json.load(f)
+    model = _build_model(meta)
+
+    def _template():
+        m = _build_model(meta)
+        m.init()
+        return {"params": m.params, "states": m.states,
+                "opt_state": m.opt_state}
+
+    abstract = jax.eval_shape(_template)  # shapes/dtypes only, no allocation
+    if shardings is not None:
+        from jax.sharding import NamedSharding, PartitionSpec as P
+        from ..parallel.sharding import opt_state_shardings
+        some = jax.tree_util.tree_leaves(shardings)[0]
+        repl = NamedSharding(some.mesh, P())
+        with_shard = lambda a, s: jax.ShapeDtypeStruct(a.shape, a.dtype,
+                                                       sharding=s)
+        abstract["params"] = jax.tree_util.tree_map(
+            with_shard, abstract["params"], shardings)
+        opt_sh = opt_state_shardings(abstract["opt_state"],
+                                     abstract["params"], shardings, repl)
+        abstract["opt_state"] = jax.tree_util.tree_map(
+            lambda a, s: with_shard(a, s) if hasattr(a, "shape") else a,
+            abstract["opt_state"], opt_sh)
+        abstract["states"] = jax.tree_util.tree_map(
+            lambda a: with_shard(a, repl), abstract["states"])
+    ckptr = ocp.StandardCheckpointer()
+    state = ckptr.restore(os.path.join(path, "state"), abstract)
+    model.params = state["params"]
+    model.states = state["states"]
+    model._build_updater(init_state=False)  # transforms only; no dense alloc
+    model.opt_state = state["opt_state"]
+    return model

@@ -133,3 +133,24 @@ def test_checkpoint_gc_keeps_last(tmp_path):
     t.fit(it, epochs=2)  # iters 1..10, ckpts at 2,4,6,8,10 + final
     names = sorted(os.listdir(ck.directory))
     assert len([n for n in names if n.startswith("ckpt-")]) <= 2
+
+
+def test_checkpoint_resume_sharded_format(tmp_path):
+    """FaultTolerantTrainer with the orbax sharded tensor-store format
+    (CheckpointConfig(format='sharded')) resumes identically to zip."""
+    X, Y = _data()
+    it = ListDataSetIterator(DataSet(X, Y), batch_size=8)
+    ck = CheckpointConfig(tmp_path / "sc", frequency=7, format="sharded")
+    t1 = FaultTolerantTrainer(_factory(), ck)
+    t1.fit(it, epochs=1)
+    t2 = FaultTolerantTrainer(_factory(), ck)
+    assert t2.resumed and t2.state["iteration"] == 10
+    np.testing.assert_allclose(t1.model.get_flat_params(),
+                               t2.model.get_flat_params(), rtol=0, atol=0)
+    t2.fit(it, epochs=2)
+
+    ref = FaultTolerantTrainer(_factory(), CheckpointConfig(tmp_path / "rf",
+                                                            frequency=0))
+    ref.fit(it, epochs=2)
+    np.testing.assert_allclose(ref.model.get_flat_params(),
+                               t2.model.get_flat_params(), rtol=1e-6, atol=1e-7)
