@@ -1,0 +1,12 @@
+"""Pallas TPU kernels for hot ops.
+
+The XLA lowerings in `nn/layers/*` are the default accelerated path (the
+reference's cuDNN-helper seam, SURVEY.md §2.3); this package holds hand-tiled
+Pallas kernels for the cases where a custom schedule beats XLA's — the TPU
+analog of the reference shipping cuDNN-specific kernels next to the generic
+path. Kernels run in interpret mode on CPU (tests) and compile via Mosaic on
+TPU.
+"""
+from .flash_attention import flash_attention
+
+__all__ = ["flash_attention"]

@@ -1,0 +1,151 @@
+"""Flash attention forward as a Pallas TPU kernel.
+
+The K/V stream tiles through VMEM with an online-softmax accumulator held in
+scratch, so the [Tq, Tk] score matrix never materializes in HBM — the same
+math as parallel/ring_attention.py's blockwise path, but hand-scheduled:
+grid (batch*heads, q-blocks, k-blocks) with the k dimension innermost
+("arbitrary" semantics) carrying (acc, m, l) scratch across iterations.
+
+Backward uses jax.custom_vjp with the reference-attention VJP (recompute; the
+fused backward kernel is future work — forward is the memory-bound hot op).
+
+Falls back transparently (see `flash_attention`) when shapes don't tile or
+Pallas is unavailable, so callers can use it unconditionally.
+"""
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+
+NEG_INF = -1e30
+
+
+def _flash_kernel(q_ref, k_ref, v_ref, o_ref, acc_ref, m_ref, l_ref, *,
+                  scale, causal, block_q, block_k, nk):
+    from jax.experimental import pallas as pl
+    ki = pl.program_id(2)
+    qi = pl.program_id(1)
+
+    @pl.when(ki == 0)
+    def _init():
+        acc_ref[...] = jnp.zeros_like(acc_ref)
+        m_ref[...] = jnp.full_like(m_ref, NEG_INF)
+        l_ref[...] = jnp.zeros_like(l_ref)
+
+    def _accumulate():
+        q = q_ref[0].astype(jnp.float32)        # [bq, d]
+        k = k_ref[0].astype(jnp.float32)        # [bk, d]
+        v = v_ref[0].astype(jnp.float32)        # [bk, d]
+        s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
+                                preferred_element_type=jnp.float32) * scale
+        if causal:
+            qpos = qi * block_q + jax.lax.broadcasted_iota(jnp.int32, s.shape, 0)
+            kpos = ki * block_k + jax.lax.broadcasted_iota(jnp.int32, s.shape, 1)
+            s = jnp.where(kpos > qpos, NEG_INF, s)
+
+        m_prev = m_ref[:, :1]                    # [bq, 1]
+        l_prev = l_ref[:, :1]
+        m_blk = jnp.max(s, axis=-1, keepdims=True)
+        m_new = jnp.maximum(m_prev, m_blk)
+        corr = jnp.exp(m_prev - m_new)
+        p = jnp.exp(s - m_new)                   # [bq, bk]
+        l_new = l_prev * corr + jnp.sum(p, axis=-1, keepdims=True)
+        acc_ref[...] = acc_ref[...] * corr + jax.lax.dot_general(
+            p, v, (((1,), (0,)), ((), ())), preferred_element_type=jnp.float32)
+        m_ref[...] = jnp.broadcast_to(m_new, m_ref.shape)
+        l_ref[...] = jnp.broadcast_to(l_new, l_ref.shape)
+
+    if causal:
+        # skip k-blocks entirely above the diagonal (~half the grid): they
+        # are fully masked and would pay both matmuls for nothing
+        pl.when(ki * block_k <= (qi + 1) * block_q - 1)(_accumulate)
+    else:
+        _accumulate()
+
+    @pl.when(ki == nk - 1)
+    def _finish():
+        # masked-out rows (fully-causal-masked early q rows never happen:
+        # diagonal blocks always contribute) — guard l=0 anyway
+        l = l_ref[:, :1]
+        o_ref[0, ...] = (acc_ref[...] /
+                         jnp.maximum(l, 1e-30)).astype(o_ref.dtype)
+
+
+def _flash_forward(q, k, v, scale, causal, block_q, block_k, interpret):
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+    B, Tq, H, D = q.shape
+    Tk = k.shape[1]
+    # fold heads into batch; kernel works on [BH, T, D]
+    qf = jnp.swapaxes(q, 1, 2).reshape(B * H, Tq, D)
+    kf = jnp.swapaxes(k, 1, 2).reshape(B * H, Tk, D)
+    vf = jnp.swapaxes(v, 1, 2).reshape(B * H, Tk, D)
+    nq = Tq // block_q
+    nk = Tk // block_k
+    kernel = functools.partial(_flash_kernel, scale=scale, causal=causal,
+                               block_q=block_q, block_k=block_k, nk=nk)
+    out = pl.pallas_call(
+        kernel,
+        grid=(B * H, nq, nk),
+        in_specs=[
+            pl.BlockSpec((1, block_q, D), lambda b, qi, ki: (b, qi, 0)),
+            pl.BlockSpec((1, block_k, D), lambda b, qi, ki: (b, ki, 0)),
+            pl.BlockSpec((1, block_k, D), lambda b, qi, ki: (b, ki, 0)),
+        ],
+        out_specs=pl.BlockSpec((1, block_q, D), lambda b, qi, ki: (b, qi, 0)),
+        out_shape=jax.ShapeDtypeStruct((B * H, Tq, D), q.dtype),
+        scratch_shapes=[
+            pltpu.VMEM((block_q, D), jnp.float32),     # acc
+            pltpu.VMEM((block_q, 128), jnp.float32),   # running max
+            pltpu.VMEM((block_q, 128), jnp.float32),   # running sum
+        ],
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "parallel", "arbitrary")),
+        interpret=interpret,
+    )(qf, kf, vf)
+    return jnp.swapaxes(out.reshape(B, H, Tq, D), 1, 2)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7))
+def _flash(q, k, v, scale, causal, block_q, block_k, interpret):
+    return _flash_forward(q, k, v, scale, causal, block_q, block_k, interpret)
+
+
+def _flash_fwd(q, k, v, scale, causal, block_q, block_k, interpret):
+    out = _flash_forward(q, k, v, scale, causal, block_q, block_k, interpret)
+    return out, (q, k, v)
+
+
+def _flash_bwd(scale, causal, block_q, block_k, interpret, res, g):
+    from ..parallel.ring_attention import attention_reference
+    q, k, v = res
+    _, vjp = jax.vjp(
+        lambda q_, k_, v_: attention_reference(q_, k_, v_, causal=causal,
+                                               scale=scale), q, k, v)
+    return vjp(g)
+
+
+_flash.defvjp(_flash_fwd, _flash_bwd)
+
+
+def flash_attention(q, k, v, *, causal=False, scale=None, block_q=128,
+                    block_k=128, interpret=None):
+    """Pallas flash attention on [batch, time, heads, head_dim] tensors.
+
+    Falls back to the pure-JAX blockwise path when the sequence doesn't tile
+    into the requested blocks or Pallas can't run (shape/platform); callers
+    may use it unconditionally."""
+    B, Tq, H, D = q.shape
+    Tk = k.shape[1]
+    if scale is None:
+        scale = float(1.0 / (D ** 0.5))
+    if interpret is None:
+        interpret = jax.default_backend() != "tpu"
+    block_q = min(block_q, Tq)
+    block_k = min(block_k, Tk)
+    if Tq % block_q or Tk % block_k or D % 8:
+        from ..parallel.ring_attention import attention_reference
+        return attention_reference(q, k, v, causal=causal, scale=scale)
+    return _flash(q, k, v, scale, causal, block_q, block_k, interpret)

@@ -278,10 +278,13 @@ class SelfAttentionLayer(BaseRecurrentConf):
     no reference counterpart (SURVEY.md §5: the reference has no attention).
     Runs flash-style blockwise attention on one device; the sequence-parallel
     long-context variant is parallel.ring_attention.ring_attention, applied to
-    the same Q/K/V projections."""
+    the same Q/K/V projections. use_pallas=True routes the unmasked forward
+    through the hand-tiled Pallas kernel (kernels/flash_attention.py;
+    interpret mode on CPU, Mosaic on TPU)."""
     n_heads: int = 4
     causal: bool = False
     block_size: int = 256
+    use_pallas: bool = False
 
 
 @register_layer_conf

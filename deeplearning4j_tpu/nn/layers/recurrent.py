@@ -194,6 +194,11 @@ class SelfAttentionLayerModule(BaseLayerModule):
         v = (x @ params["Wv"]).reshape(B, T, H, Dh)
         if mask is not None:
             out = attention_reference(q, k, v, causal=c.causal, key_mask=mask)
+        elif getattr(c, "use_pallas", False):
+            from ...kernels import flash_attention
+            out = flash_attention(q, k, v, causal=c.causal,
+                                  block_q=int(c.block_size),
+                                  block_k=int(c.block_size))
         elif T % min(int(c.block_size), T) == 0:
             out = blockwise_attention(q, k, v, block_size=int(c.block_size),
                                       causal=c.causal)

@@ -1,0 +1,124 @@
+"""Pallas kernel tests (interpret mode on CPU; the same kernels compile via
+Mosaic on TPU). Parity oracle: parallel/ring_attention.attention_reference."""
+import numpy as np
+import jax
+import jax.numpy as jnp
+import pytest
+
+from deeplearning4j_tpu.kernels import flash_attention
+from deeplearning4j_tpu.parallel.ring_attention import attention_reference
+
+
+def _qkv(b=2, t=64, h=2, d=16, seed=0, dtype=np.float32):
+    rng = np.random.default_rng(seed)
+    mk = lambda: jnp.asarray(rng.normal(size=(b, t, h, d)).astype(dtype))
+    return mk(), mk(), mk()
+
+
+@pytest.mark.parametrize("causal", [False, True])
+def test_flash_attention_matches_reference(causal):
+    q, k, v = _qkv()
+    out = flash_attention(q, k, v, causal=causal, block_q=16, block_k=16)
+    ref = attention_reference(q, k, v, causal=causal)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               rtol=2e-5, atol=2e-6)
+
+
+def test_flash_attention_multi_block_asymmetric():
+    # Tq != Tk (cross-attention shape) and several blocks each way
+    rng = np.random.default_rng(3)
+    q = jnp.asarray(rng.normal(size=(1, 48, 2, 16)).astype(np.float32))
+    k = jnp.asarray(rng.normal(size=(1, 96, 2, 16)).astype(np.float32))
+    v = jnp.asarray(rng.normal(size=(1, 96, 2, 16)).astype(np.float32))
+    out = flash_attention(q, k, v, block_q=16, block_k=32)
+    ref = attention_reference(q, k, v)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               rtol=2e-5, atol=2e-6)
+
+
+def test_flash_attention_gradients_match_reference():
+    q, k, v = _qkv(t=32, d=8, seed=5)
+
+    def loss_flash(q, k, v):
+        return jnp.sum(flash_attention(q, k, v, causal=True, block_q=16,
+                                       block_k=16) ** 2)
+
+    def loss_ref(q, k, v):
+        return jnp.sum(attention_reference(q, k, v, causal=True) ** 2)
+
+    gf = jax.grad(loss_flash, argnums=(0, 1, 2))(q, k, v)
+    gr = jax.grad(loss_ref, argnums=(0, 1, 2))(q, k, v)
+    for a, b in zip(gf, gr):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=2e-4, atol=2e-5)
+
+
+def test_flash_attention_fallback_on_ragged_seq():
+    # T=50 doesn't tile into 16-blocks -> silently uses the reference path
+    rng = np.random.default_rng(7)
+    q = jnp.asarray(rng.normal(size=(1, 50, 1, 8)).astype(np.float32))
+    out = flash_attention(q, q, q, causal=True, block_q=16, block_k=16)
+    ref = attention_reference(q, q, q, causal=True)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), rtol=1e-5)
+
+
+def test_flash_attention_bf16():
+    q, k, v = _qkv(t=32, d=16, dtype=np.float32)
+    q, k, v = (x.astype(jnp.bfloat16) for x in (q, k, v))
+    out = flash_attention(q, k, v, block_q=16, block_k=16)
+    assert out.dtype == jnp.bfloat16
+    ref = attention_reference(q.astype(jnp.float32), k.astype(jnp.float32),
+                              v.astype(jnp.float32))
+    np.testing.assert_allclose(np.asarray(out, dtype=np.float32),
+                               np.asarray(ref), rtol=5e-2, atol=5e-2)
+
+
+def test_self_attention_layer_pallas_path_matches():
+    """SelfAttentionLayer(use_pallas=True) must produce the same network
+    outputs and train the same as the XLA blockwise path."""
+    from deeplearning4j_tpu import (NeuralNetConfiguration, InputType,
+                                    SelfAttentionLayer, RnnOutputLayer,
+                                    MultiLayerNetwork, DataSet, Sgd)
+
+    def build(use_pallas):
+        # n_out=16 / n_heads=2 -> head_dim 8: satisfies the kernel's D % 8
+        # guard, so the pallas path genuinely executes (not the fallback)
+        conf = (NeuralNetConfiguration.builder().seed(4).updater(Sgd(0.05))
+                .list()
+                .layer(SelfAttentionLayer(n_out=16, n_heads=2, causal=True,
+                                          block_size=8, use_pallas=use_pallas,
+                                          activation="identity"))
+                .layer(RnnOutputLayer(n_out=3, activation="softmax",
+                                      loss="MCXENT"))
+                .set_input_type(InputType.recurrent(6))
+                .build())
+        return MultiLayerNetwork(conf).init()
+
+    rng = np.random.default_rng(0)
+    x = rng.normal(size=(2, 16, 6)).astype(np.float32)
+    y = np.eye(3, dtype=np.float32)[rng.integers(0, 3, (2, 16))]
+    a, b = build(False), build(True)
+
+    # prove the kernel path is actually taken, not the shape fallback
+    import importlib
+    # the package re-exports the function under the submodule's name, so
+    # attribute-style import resolves to the function; go via sys.modules
+    fa_mod = importlib.import_module(
+        "deeplearning4j_tpu.kernels.flash_attention")
+    calls = []
+    orig = fa_mod._flash_forward
+    fa_mod._flash_forward = lambda *a_, **k_: (calls.append(1),
+                                               orig(*a_, **k_))[1]
+    try:
+        out_b = np.asarray(b.output(x))
+    finally:
+        fa_mod._flash_forward = orig
+    assert calls, "pallas kernel was never invoked — fallback took over"
+
+    np.testing.assert_allclose(np.asarray(a.output(x)), out_b,
+                               rtol=1e-5, atol=1e-6)
+    for _ in range(3):
+        a.fit(DataSet(x, y))
+        b.fit(DataSet(x, y))
+    np.testing.assert_allclose(a.get_flat_params(), b.get_flat_params(),
+                               rtol=1e-4, atol=1e-5)
