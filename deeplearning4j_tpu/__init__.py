@@ -13,7 +13,8 @@ from .nn.conf.layers import (DenseLayer, OutputLayer, RnnOutputLayer, LossLayer,
                              LocalResponseNormalization, GravesLSTM, LSTM,
                              GravesBidirectionalLSTM, ActivationLayer, DropoutLayer,
                              GlobalPoolingLayer, ZeroPaddingLayer, AutoEncoder, RBM,
-                             VariationalAutoencoder, SelfAttentionLayer)
+                             VariationalAutoencoder, SelfAttentionLayer,
+                             LayerNormalization)
 from .nn.updaters import (Sgd, Adam, AdaMax, AdaDelta, AdaGrad, RmsProp, Nesterovs,
                           NoOp, GradientNormalization)
 from .nn.weights import WeightInit

@@ -44,7 +44,12 @@ def expected_input_kind(conf):
         # consume [b, t, f]
         return "recurrent"
     if isinstance(conf, (L.ActivationLayer, L.DropoutLayer, L.LossLayer,
-                         L.GlobalPoolingLayer, L.BatchNormalization)):
+                         L.GlobalPoolingLayer, L.BatchNormalization,
+                         L.LayerNormalization)):
+        return "any"
+    if type(conf) is L.DenseLayer:
+        # Dense is time-distributed on [b, t, f] (no RnnToFeedForward needed)
+        # and self-flattens rank-4 CNN input; only cnn_flat still reshapes
         return "any"
     return "ff"
 

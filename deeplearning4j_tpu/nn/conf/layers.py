@@ -114,8 +114,15 @@ class FeedForwardLayerConf(BaseLayerConf):
 @register_layer_conf
 @dataclass
 class DenseLayer(FeedForwardLayerConf):
-    """Fully connected layer (reference: nn/conf/layers/DenseLayer.java)."""
-    pass
+    """Fully connected layer (reference: nn/conf/layers/DenseLayer.java).
+    On [b, t, f] input it applies per-timestep (time-distributed; one batched
+    gemm) and stays recurrent — beyond the reference, which demands
+    RnnToFeedForward wrapping."""
+
+    def get_output_type(self, input_type):
+        if isinstance(input_type, RecurrentInputType):
+            return InputType.recurrent(self.n_out)
+        return InputType.feed_forward(self.n_out)
 
 
 @register_layer_conf
@@ -220,6 +227,40 @@ class SubsamplingLayer(_NoActivationConf):
         return InputType.convolutional(oh, ow, input_type.channels)
 
 
+def _norm_set_n_in(self, input_type):
+    """Shared n_in inference for the normalization confs: channel count for
+    CNN activations, feature size otherwise; n_out mirrors n_in."""
+    if self.n_in in (None, 0):
+        if isinstance(input_type, ConvolutionalInputType):
+            self.n_in = input_type.channels
+        else:
+            self.n_in = input_type.flat_size()
+    self.n_out = self.n_in
+
+
+@register_layer_conf
+@dataclass
+class LayerNormalization(BaseLayerConf):
+    """Layer norm over the feature (last) axis — NEW capability beyond the
+    reference's 2017 layer set (no LayerNormalization.java exists at v0.7.3);
+    added because the transformer family (zoo.transformer_lm) needs it.
+    Stateless (no running statistics), works on [b,f], [b,t,f], [b,h,w,c]."""
+    n_in: int | None = None
+    n_out: int | None = None
+    eps: float = 1e-5
+
+    def apply_global_defaults(self, g):
+        explicit = self.activation
+        super().apply_global_defaults(g)
+        if explicit is None:
+            self.activation = "identity"
+
+    set_n_in = _norm_set_n_in
+
+    def get_output_type(self, input_type):
+        return input_type
+
+
 @register_layer_conf
 @dataclass
 class BatchNormalization(BaseLayerConf):
@@ -240,13 +281,7 @@ class BatchNormalization(BaseLayerConf):
         if explicit is None:
             self.activation = "identity"
 
-    def set_n_in(self, input_type):
-        if self.n_in in (None, 0):
-            if isinstance(input_type, ConvolutionalInputType):
-                self.n_in = input_type.channels
-            else:
-                self.n_in = input_type.flat_size()
-        self.n_out = self.n_in
+    set_n_in = _norm_set_n_in
 
     def get_output_type(self, input_type):
         return input_type

@@ -128,6 +128,28 @@ class LocalResponseNormalizationModule(BaseLayerModule):
         return x / denom, state, mask
 
 
+@register_impl("LayerNormalization")
+class LayerNormalizationModule(BaseLayerModule):
+    """Layer norm over the last axis (stateless; NEW — the reference's 2017
+    layer set has no LayerNormalization). Per-position mean/variance keep
+    transformer activations stable regardless of batch composition."""
+
+    def init(self, rng, input_type, dtype=jnp.float32):
+        c = self.conf
+        n = int(c.n_in)
+        params = {"gamma": jnp.ones((n,), dtype),
+                  "beta": jnp.zeros((n,), dtype)}
+        return params, {}, input_type
+
+    def forward(self, params, state, x, *, train=False, rng=None, mask=None):
+        c = self.conf
+        mu = jnp.mean(x, axis=-1, keepdims=True)
+        var = jnp.mean(jnp.square(x - mu), axis=-1, keepdims=True)
+        y = (x - mu) * lax.rsqrt(var + c.eps)
+        y = y * params["gamma"] + params["beta"]
+        return self.activation_fn()(y), state, mask
+
+
 @register_impl("BatchNormalization")
 class BatchNormalizationModule(BaseLayerModule):
     """Batch normalization over the channel (last) axis for NHWC or the feature

@@ -32,10 +32,17 @@ class _DenseCore(BaseLayerModule):
                               fan_out=n_out, distribution=c.dist, dtype=dtype),
             "b": jnp.full((n_out,), c.bias_init or 0.0, dtype),
         }
-        return params, {}, InputType.feed_forward(n_out)
+        from ..conf.inputs import RecurrentInputType
+        out_t = (InputType.recurrent(n_out)
+                 if isinstance(input_type, RecurrentInputType)
+                 else InputType.feed_forward(n_out))
+        return params, {}, out_t
 
     def preoutput(self, params, x):
-        if x.ndim > 2:
+        # rank-3 [b, t, f] stays time-distributed (one batched gemm — beyond
+        # the reference, which needs RnnToFeedForward wrapping); only rank-4
+        # CNN activations flatten
+        if x.ndim > 3:
             x = x.reshape(x.shape[0], -1)
         return x @ params["W"] + params["b"]
 

@@ -146,6 +146,48 @@ def resnet50(num_classes=1000, image_size=224, seed=12345, updater=None,
     return ComputationGraph(gb.build())
 
 
+def transformer_lm(vocab_size=256, d_model=256, n_layers=4, n_heads=4,
+                   ffn_mult=4, seed=12345, causal=True, use_pallas=False,
+                   compute_dtype=None, updater=None):
+    """Decoder-only transformer language model — NEW model family beyond the
+    reference's 2017 zoo (no attention exists in DL4J v0.7.3; SURVEY.md §5
+    names long-context attention as this framework's new capability). Built
+    from the same DSL vocabulary as everything else: SelfAttentionLayer
+    (optionally the Pallas flash kernel), LayerNormalization (post-norm),
+    per-timestep Dense FFN, ElementWiseVertex residuals. Input: one-hot
+    [b, t, vocab]; output: next-token softmax per position."""
+    from ..nn.conf.layers import LayerNormalization, SelfAttentionLayer
+    gb = (NeuralNetConfiguration.builder()
+          .seed(seed).updater(updater or Adam(3e-4)).weight_init("xavier")
+          .compute_dtype(compute_dtype)
+          .graph_builder()
+          .add_inputs("tokens"))
+    gb.add_layer("embed", DenseLayer(n_out=d_model, activation="identity"),
+                 "tokens")
+    prev = "embed"
+    for i in range(n_layers):
+        gb.add_layer(f"b{i}_attn",
+                     SelfAttentionLayer(n_out=d_model, n_heads=n_heads,
+                                        causal=causal, use_pallas=use_pallas,
+                                        activation="identity"), prev)
+        gb.add_vertex(f"b{i}_res1", ElementWiseVertex("add"), prev, f"b{i}_attn")
+        gb.add_layer(f"b{i}_ln1", LayerNormalization(), f"b{i}_res1")
+        gb.add_layer(f"b{i}_ffn1", DenseLayer(n_out=d_model * ffn_mult,
+                                              activation="relu"), f"b{i}_ln1")
+        gb.add_layer(f"b{i}_ffn2", DenseLayer(n_out=d_model,
+                                              activation="identity"),
+                     f"b{i}_ffn1")
+        gb.add_vertex(f"b{i}_res2", ElementWiseVertex("add"), f"b{i}_ln1",
+                      f"b{i}_ffn2")
+        gb.add_layer(f"b{i}_ln2", LayerNormalization(), f"b{i}_res2")
+        prev = f"b{i}_ln2"
+    gb.add_layer("out", RnnOutputLayer(n_out=vocab_size, activation="softmax",
+                                       loss="MCXENT"), prev)
+    gb.set_outputs("out")
+    gb.set_input_types(InputType.recurrent(vocab_size))
+    return ComputationGraph(gb.build())
+
+
 def vgg16(num_classes=1000, image_size=224, seed=12345):
     """VGG16 (reference: trainedmodels/TrainedModels.java VGG16)."""
     b = (NeuralNetConfiguration.builder()
