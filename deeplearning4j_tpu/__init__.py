@@ -14,7 +14,7 @@ from .nn.conf.layers import (DenseLayer, OutputLayer, RnnOutputLayer, LossLayer,
                              GravesBidirectionalLSTM, ActivationLayer, DropoutLayer,
                              GlobalPoolingLayer, ZeroPaddingLayer, AutoEncoder, RBM,
                              VariationalAutoencoder, SelfAttentionLayer,
-                             LayerNormalization)
+                             LayerNormalization, MixtureOfExpertsLayer)
 from .nn.updaters import (Sgd, Adam, AdaMax, AdaDelta, AdaGrad, RmsProp, Nesterovs,
                           NoOp, GradientNormalization)
 from .nn.weights import WeightInit

@@ -372,6 +372,28 @@ class DropoutLayer(_NoActivationConf):
 
 @register_layer_conf
 @dataclass
+class MixtureOfExpertsLayer(FeedForwardLayerConf):
+    """Mixture-of-experts feed-forward block — NEW capability beyond the
+    reference (no MoE exists at v0.7.3; SURVEY.md §2.4 lists expert
+    parallelism as absent upstream). Router: softmax top-k gating over
+    n_experts; each expert is a 2-layer FFN (n_in -> hidden -> n_out).
+    Compute is dense over the expert axis (every expert runs, gates weight
+    the mix) so the whole block is one einsum chain that GSPMD partitions
+    over a mesh axis when the expert-indexed weights are sharded
+    P("model", ...) — that sharding IS expert parallelism. Works on [b, f]
+    and time-distributed [b, t, f]."""
+    n_experts: int = 4
+    hidden_mult: int = 2
+    top_k: int = 2  # gates outside top-k are zeroed (renormalized)
+
+    def get_output_type(self, input_type):
+        if isinstance(input_type, RecurrentInputType):
+            return InputType.recurrent(self.n_out)
+        return InputType.feed_forward(self.n_out)
+
+
+@register_layer_conf
+@dataclass
 class GlobalPoolingLayer(_NoActivationConf):
     """Pool over time (rnn) or space (cnn) to fixed-size vectors
     (reference: nn/conf/layers/GlobalPoolingLayer.java, runtime

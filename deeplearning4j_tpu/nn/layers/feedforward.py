@@ -260,3 +260,60 @@ class RBMModule(_DenseCore):
             return -hidden_term - vbias_term
 
         return jnp.mean(free_energy(v0) - free_energy(vk))
+
+
+@register_impl("MixtureOfExpertsLayer")
+class MixtureOfExpertsLayerModule(BaseLayerModule):
+    """Dense mixture-of-experts FFN (conf: nn/conf/layers.py
+    MixtureOfExpertsLayer — NEW, no reference counterpart). Expert weights
+    are expert-major [E, ...]; sharding axis 0 over a mesh "model" axis
+    yields expert parallelism (GSPMD partitions the einsums and all-reduces
+    the gated mix)."""
+
+    def init(self, rng, input_type, dtype=jnp.float32):
+        c = self.conf
+        n_in, n_out = int(c.n_in), int(c.n_out)
+        E = int(c.n_experts)
+        hidden = int(c.hidden_mult) * n_out
+        k1, k2, k3 = jax.random.split(rng, 3)
+        mk = lambda k, shape, fi, fo: init_weights(
+            k, shape, c.weight_init, fan_in=fi, fan_out=fo,
+            distribution=c.dist, dtype=dtype)
+        params = {
+            "Wg": mk(k1, (n_in, E), n_in, E),              # router
+            "W1": mk(k2, (E, n_in, hidden), n_in, hidden),  # expert up-proj
+            "b1": jnp.zeros((E, hidden), dtype),
+            "W2": mk(k3, (E, hidden, n_out), hidden, n_out),
+            "b2": jnp.zeros((E, n_out), dtype),
+        }
+        from ..conf.inputs import RecurrentInputType
+        out_t = (InputType.recurrent(n_out)
+                 if isinstance(input_type, RecurrentInputType)
+                 else InputType.feed_forward(n_out))
+        return params, {}, out_t
+
+    def forward(self, params, state, x, *, train=False, rng=None, mask=None):
+        c = self.conf
+        x = apply_dropout(x, c.dropout, train, rng)
+        squeeze = x.ndim == 2
+        if squeeze:
+            x = x[:, None, :]                       # [b, 1, f]
+        E = int(c.n_experts)
+        k = min(int(c.top_k), E)
+        gates = jax.nn.softmax(x @ params["Wg"], axis=-1)   # [b, t, E]
+        if k < E:
+            # zero all but the top-k gates, renormalize (standard MoE)
+            thresh = jnp.sort(gates, axis=-1)[..., E - k][..., None]
+            gates = jnp.where(gates >= thresh, gates, 0.0)
+            gates = gates / jnp.maximum(
+                jnp.sum(gates, axis=-1, keepdims=True), 1e-9)
+        h = jnp.einsum("btf,efh->beth", x, params["W1"]) \
+            + params["b1"][None, :, None, :]
+        h = jax.nn.relu(h)
+        y = jnp.einsum("beth,eho->beto", h, params["W2"]) \
+            + params["b2"][None, :, None, :]
+        out = jnp.einsum("bte,beto->bto", gates, y)
+        out = self.activation_fn()(out)
+        if squeeze:
+            out = out[:, 0, :]
+        return out, state, mask

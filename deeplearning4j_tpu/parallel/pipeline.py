@@ -1,0 +1,215 @@
+"""Pipeline parallelism: GPipe-style stage partitioning with microbatching.
+
+NEW capability with no reference counterpart (SURVEY.md §2.4 "Absent": no
+pipeline parallelism upstream). A MultiLayerNetwork's layer stack is split
+into S contiguous stages, each stage's parameters live on their own device,
+and every global batch is fed as M microbatches: stage s runs microbatch m
+while stage s+1 runs microbatch m-1 (the classic GPipe schedule — here the
+overlap comes from JAX's async dispatch: each stage's jitted microbatch step
+is enqueued on its own device queue and the host never blocks between
+enqueues). Backward replays the saved per-stage VJPs in reverse, gradients
+accumulate across microbatches, and the model's own per-layer optax
+transforms apply the update stage-locally.
+
+Equivalence contract (tested): with mean losses and equal microbatches,
+pipeline training over S stages x M microbatches produces the SAME parameter
+update as single-device full-batch training.
+"""
+from __future__ import annotations
+
+import warnings
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+import optax
+
+from ..nn.updaters import apply_gradient_normalization
+
+
+class PipelineTrainer:
+    def __init__(self, model, n_stages=2, n_microbatches=4, devices=None,
+                 boundaries=None):
+        """boundaries: optional explicit stage split points (layer indices);
+        default splits layers evenly. devices: one per stage (defaults to the
+        first n_stages of jax.devices())."""
+        from ..nn.multilayer.network import MultiLayerNetwork
+        if not isinstance(model, MultiLayerNetwork):
+            raise TypeError("PipelineTrainer drives MultiLayerNetwork models")
+        self.model = model
+        if model.params is None:
+            model.init()
+        n_layers = len(model.layers)
+        self.n_stages = int(n_stages)
+        self.n_microbatches = int(n_microbatches)
+        if self.n_stages > n_layers:
+            raise ValueError(f"{self.n_stages} stages > {n_layers} layers")
+        if boundaries is None:
+            # balanced split: every stage gets >= 1 layer
+            boundaries = [round(i * n_layers / self.n_stages)
+                          for i in range(1, self.n_stages)]
+        self.boundaries = [0] + list(boundaries) + [n_layers]
+        if any(self.boundaries[i] >= self.boundaries[i + 1]
+               for i in range(self.n_stages)):
+            raise ValueError(f"empty pipeline stage: {self.boundaries}")
+        self.devices = list(devices) if devices is not None else \
+            jax.devices()[: self.n_stages]
+        if len(self.devices) < self.n_stages:
+            raise ValueError(f"need {self.n_stages} devices, have "
+                             f"{len(self.devices)}")
+        self._place_stages()
+        self._fwd_jits = {}
+
+    # ------------------------------------------------------------ placement
+    def _stage_layers(self, s):
+        return range(self.boundaries[s], self.boundaries[s + 1])
+
+    def _place_stages(self):
+        m = self.model
+        for s in range(self.n_stages):
+            dev = self.devices[s]
+            for i in self._stage_layers(s):
+                k = str(i)
+                m.params[k] = jax.device_put(m.params[k], dev)
+                m.states[k] = jax.device_put(m.states[k], dev)
+        # opt state stays where optax puts it; updates run stage-locally
+        if any(jax.tree_util.tree_leaves(v) for v in m.states.values()):
+            warnings.warn(
+                "PipelineTrainer does not update per-layer state "
+                "(BatchNormalization running statistics stay at their "
+                "current values); train stateful layers with fit()/"
+                "ShardedTrainer instead", stacklevel=3)
+
+    # ------------------------------------------------------------- forward
+    def _stage_forward(self, s):
+        """Jitted pure forward for stage s: (params_slice, x) -> (out, states).
+        The LAST stage returns the mean loss instead (labels threaded in)."""
+        m = self.model
+        last = s == self.n_stages - 1
+        idxs = list(self._stage_layers(s))
+
+        cd = m._compute_dtype()
+
+        def _run(pslice, feats, rng, layer_idxs):
+            for i in layer_idxs:
+                pre = m.conf.input_preprocessors.get(i)
+                if rng is not None:
+                    rng, pre_rng, sub = jax.random.split(rng, 3)
+                else:
+                    pre_rng = sub = None
+                if pre is not None:
+                    feats = pre(feats, None, rng=pre_rng)
+                feats, _, _ = m.layers[i].forward(
+                    pslice[str(i)], m.states[str(i)], feats,
+                    train=True, rng=sub)[:3]
+            return feats
+
+        if s not in self._fwd_jits:
+            if last:
+                def fn(pslice, x, y, rng):
+                    # mixed precision mirrors the single-device step: hidden
+                    # layers in the compute dtype, output layer + loss in f32
+                    out_i = idxs[-1]
+                    if cd is not None:
+                        pslice = {k: (v if k == str(out_i)
+                                      else m._cast_floats(v, cd))
+                                  for k, v in pslice.items()}
+                        x = x.astype(cd) if jnp.issubdtype(
+                            x.dtype, jnp.floating) else x
+                    feats = _run(pslice, x, rng, idxs[:-1])
+                    feats2, _ = m._apply_preprocessor(out_i, feats, None)
+                    if cd is not None:
+                        feats2 = feats2.astype(m._dtype)
+                    return m.layers[out_i].score(pslice[str(out_i)], feats2,
+                                                 y, None, True, None)
+            else:
+                def fn(pslice, x, rng):
+                    if cd is not None:
+                        pslice = m._cast_floats(pslice, cd)
+                        x = x.astype(cd) if jnp.issubdtype(
+                            x.dtype, jnp.floating) else x
+                    return _run(pslice, x, rng, idxs)
+            self._fwd_jits[s] = jax.jit(fn)  # placement follows the inputs
+        return self._fwd_jits[s]
+
+    # -------------------------------------------------------------- train
+    def fit_batch(self, ds):
+        """One pipelined step: microbatch forward wavefront, reverse VJP
+        backward, accumulated grads, per-layer update applied in place."""
+        m = self.model
+        x_np = np.asarray(ds.features)
+        y_np = np.asarray(ds.labels)
+        B = x_np.shape[0]
+        M = self.n_microbatches
+        if B % M:
+            raise ValueError(f"batch {B} must divide into {M} microbatches")
+        xs = np.split(x_np, M)
+        ys = np.split(y_np, M)
+
+        S = self.n_stages
+        pslices = [{str(i): m.params[str(i)] for i in self._stage_layers(s)}
+                   for s in range(S)]
+
+        # forward wavefront: for each microbatch, run stages in order,
+        # device_put-ing activations across stage boundaries; vjps saved
+        m._rng, step_rng = jax.random.split(m._rng)
+        mb_rngs = jax.random.split(step_rng, M * S).reshape(M, S, -1)
+        vjps = [[None] * S for _ in range(M)]
+        losses = []
+        for mb in range(M):
+            act = jax.device_put(jnp.asarray(xs[mb]), self.devices[0])
+            for s in range(S - 1):
+                r = jax.device_put(mb_rngs[mb, s], self.devices[s])
+                out, vjp = jax.vjp(
+                    lambda p, a, s=s, r=r: self._stage_forward(s)(p, a, r),
+                    pslices[s], act)
+                vjps[mb][s] = vjp
+                act = jax.device_put(out, self.devices[s + 1])
+            y_dev = jax.device_put(jnp.asarray(ys[mb]), self.devices[S - 1])
+            r = jax.device_put(mb_rngs[mb, S - 1], self.devices[S - 1])
+            loss, vjp = jax.vjp(
+                lambda p, a, r=r: self._stage_forward(S - 1)(p, a, y_dev, r),
+                pslices[S - 1], act)
+            vjps[mb][S - 1] = vjp
+            losses.append(loss)
+
+        # backward: reverse stages per microbatch; grads accumulate
+        grad_acc = [None] * S
+        for mb in range(M):
+            cot = jnp.ones((), losses[mb].dtype)
+            for s in reversed(range(S)):
+                gp, gx = vjps[mb][s](cot)
+                grad_acc[s] = gp if grad_acc[s] is None else \
+                    jax.tree_util.tree_map(jnp.add, grad_acc[s], gp)
+                if s > 0:
+                    cot = jax.device_put(gx, self.devices[s - 1])
+
+        # per-layer update on each stage's device (grads averaged over M —
+        # each microbatch loss is a mean, so sum/M == full-batch gradient)
+        for s in range(S):
+            for i in self._stage_layers(s):
+                k = str(i)
+                g = jax.tree_util.tree_map(lambda a: a / M, grad_acc[s][k])
+                lc = m.conf.layers[i]
+                if lc.gradient_normalization and g:
+                    g = apply_gradient_normalization(
+                        g, lc.gradient_normalization,
+                        lc.gradient_normalization_threshold or 1.0)
+                # apply just this layer's sub-transform
+                upd, new_state = m._tx.update({k: g}, {k: _opt_slice(m, k)},
+                                              {k: m.params[k]})
+                m.params[k] = optax.apply_updates(m.params[k], upd[k])
+                _set_opt_slice(m, k, new_state[k])
+        m.score_value = float(np.mean([float(l) for l in losses]))
+        m.iteration_count += 1
+        for listener in m.listeners:
+            listener.iteration_done(m, m.iteration_count)
+        return m.score_value
+
+
+def _opt_slice(m, k):
+    return m.opt_state[k]
+
+
+def _set_opt_slice(m, k, v):
+    m.opt_state[k] = v
