@@ -2,9 +2,14 @@
 
 Run: python examples/01_lenet_mnist.py
 (MNIST falls back to a deterministic synthetic digit set when the real
-download is unavailable; place the IDX files under ~/.deeplearning4j_tpu to
+download is unavailable; place the IDX files under ~/.deeplearning4j_tpu/mnist to
 use real data.)
 """
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
 from deeplearning4j_tpu import ModelSerializer, ScoreIterationListener
 from deeplearning4j_tpu.datasets.fetchers.mnist import MnistDataSetIterator
 from deeplearning4j_tpu.zoo.models import lenet_mnist

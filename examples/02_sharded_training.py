@@ -7,6 +7,11 @@ Run on one host: python examples/02_sharded_training.py
 Multi-host: call parallel.multihost.initialize(coordinator, N, i) in every
 process first; everything below is unchanged (SPMD).
 """
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
 import numpy as np
 import jax
 from jax.sharding import PartitionSpec as P
@@ -17,7 +22,8 @@ from deeplearning4j_tpu.parallel.sharding import (make_mesh, ShardedTrainer,
                                                   ShardingRules)
 
 n = len(jax.devices())
-mesh = make_mesh(n_data=max(1, n // 2), n_model=2 if n >= 2 else 1)
+# model axis only when the device count splits evenly; otherwise pure DP
+mesh = make_mesh(n_model=2 if n % 2 == 0 and n >= 2 else 1)
 
 conf = (NeuralNetConfiguration.builder().seed(7).updater(Adam(1e-3)).list()
         .layer(DenseLayer(n_out=512, activation="relu"))

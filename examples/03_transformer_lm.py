@@ -3,6 +3,11 @@ precision and the Pallas flash-attention kernel.
 
 Run: python examples/03_transformer_lm.py
 """
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
 import numpy as np
 
 from deeplearning4j_tpu.datasets.dataset import DataSet

@@ -2,6 +2,11 @@
 
 Run: python examples/04_serving_and_fault_tolerance.py
 """
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
 import json
 import urllib.request
 
