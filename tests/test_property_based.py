@@ -1,0 +1,116 @@
+"""Property-based tests (hypothesis) for the hand-rolled codecs and invariant
+surfaces — the places where example-based tests under-cover the input space:
+the HDF5 writer/reader, the streaming serde, the masked losses, the Viterbi
+decoder, and the native CSV fast path's exact parity with the Python parser.
+"""
+import numpy as np
+import pytest
+from hypothesis import given, settings, strategies as st
+
+from deeplearning4j_tpu.modelimport import hdf5_lite
+from deeplearning4j_tpu.streaming.serde import serialize_array, deserialize_array
+
+
+_names = st.text(alphabet=st.characters(min_codepoint=97, max_codepoint=122),
+                 min_size=1, max_size=8)
+
+
+@settings(max_examples=25, deadline=None)
+@given(st.lists(st.tuples(_names,
+                          st.integers(1, 4), st.integers(1, 6)),
+                min_size=1, max_size=8, unique_by=lambda t: t[0]))
+def test_hdf5_writer_reader_roundtrip_any_tree(specs):
+    """Arbitrary group trees of float32 datasets survive the self-contained
+    writer -> reader roundtrip exactly."""
+    f = hdf5_lite.H5File()
+    rng = np.random.default_rng(0)
+    expected = {}
+    for i, (name, ndim, dim) in enumerate(specs):
+        shape = tuple(rng.integers(1, dim + 1) for _ in range(ndim))
+        arr = rng.normal(size=shape).astype(np.float32)
+        grp = f.create_group(f"g{i}")
+        grp.create_dataset(name, arr)
+        expected[(f"g{i}", name)] = arr
+    import tempfile, os
+    with tempfile.NamedTemporaryFile(suffix=".h5", delete=False) as tmp:
+        path = tmp.name
+    try:
+        f.save(path)
+        root = hdf5_lite.load(path)
+        for (g, name), arr in expected.items():
+            np.testing.assert_array_equal(root[g][name].value, arr)
+    finally:
+        os.unlink(path)
+
+
+@settings(max_examples=30, deadline=None)
+@given(st.sampled_from(["float32", "float64", "int32", "uint8"]),
+       st.lists(st.integers(1, 5), min_size=1, max_size=3))
+def test_streaming_serde_roundtrip_any_dtype_shape(dtype, shape):
+    rng = np.random.default_rng(1)
+    if dtype.startswith("float"):
+        a = rng.normal(size=shape).astype(dtype)
+    else:
+        a = rng.integers(0, 100, size=shape).astype(dtype)
+    b = deserialize_array(serialize_array(a))
+    np.testing.assert_array_equal(a, b)
+    assert b.dtype == np.dtype(dtype)
+
+
+@settings(max_examples=30, deadline=None)
+@given(st.integers(2, 5), st.integers(1, 24), st.floats(0.6, 0.99),
+       st.floats(0.8, 0.999))
+def test_viterbi_decode_invariants(states, frames, meta, pc):
+    """Viterbi output is always a valid label sequence, and a constant
+    observation sequence decodes to itself."""
+    from deeplearning4j_tpu.util.viterbi import Viterbi
+    v = Viterbi(np.arange(states), meta_stability=meta, p_correct=pc)
+    rng = np.random.default_rng(states * frames)
+    obs = rng.integers(0, states, frames)
+    ll, path = v.decode(obs, binary_label_matrix=False)
+    assert path.shape == (frames,)
+    assert set(np.unique(path)).issubset(set(range(states)))
+    assert ll <= 0.0
+    const = np.full(frames, obs[0] if frames else 0)
+    _, cpath = v.decode(const, binary_label_matrix=False)
+    np.testing.assert_array_equal(cpath, const)
+
+
+@settings(max_examples=30, deadline=None)
+@given(st.integers(1, 6), st.integers(1, 5))
+def test_masked_loss_all_ones_mask_equals_unmasked(b, f):
+    """A mask of all ones must not change any loss value."""
+    import jax.numpy as jnp
+    from deeplearning4j_tpu.nn.losses import get_loss
+    rng = np.random.default_rng(b * 10 + f)
+    labels = jnp.asarray(np.eye(f)[rng.integers(0, f, b)].astype(np.float64)) \
+        if f > 1 else jnp.asarray(rng.random((b, 1)))
+    pre = jnp.asarray(rng.normal(size=(b, f)))
+    for name, act in (("MSE", "identity"), ("L1", "identity"),
+                      ("MCXENT", "softmax"), ("XENT", "sigmoid")):
+        if name in ("MCXENT",) and f == 1:
+            continue
+        loss = get_loss(name)
+        full = float(loss(labels, pre, act))
+        masked = float(loss(labels, pre, act, jnp.ones((b,))))
+        np.testing.assert_allclose(masked, full, rtol=1e-9, atol=1e-12)
+
+
+@settings(max_examples=40, deadline=None)
+@given(st.lists(st.lists(st.floats(-1e6, 1e6,
+                                   allow_nan=False).map(lambda v: round(v, 4)),
+                         min_size=1, max_size=5),
+                min_size=1, max_size=6))
+def test_native_csv_parity_with_python_float(rows):
+    """Whenever the native CSV fast path accepts a buffer, its values must
+    equal Python float() parsing exactly (float64 parity contract)."""
+    from deeplearning4j_tpu import native
+    if not native.available():
+        pytest.skip("no native toolchain")
+    width = len(rows[0])
+    rows = [r[:width] + [0.0] * (width - len(r)) for r in rows]
+    text = "\n".join(",".join(repr(v) for v in r) for r in rows) + "\n"
+    out = native.csv_parse(text.encode())
+    assert out is not None, "plain numeric CSV must take the fast path"
+    expect = np.array([[float(repr(v)) for v in r] for r in rows], np.float64)
+    np.testing.assert_array_equal(out, expect)
