@@ -50,6 +50,82 @@ class KerasLayer:
         self.name = config.get("name", class_name)
 
 
+# ------------------------------------------------- Keras 2.x normalization
+
+def _is_keras2_sequential(config):
+    """Keras 2 wraps the layer list: {"config": {"name":..., "layers":[...]}}
+    (Keras 1 stores the list directly)."""
+    return isinstance(config.get("config"), dict) and \
+        "layers" in config["config"]
+
+
+def _normalize_keras2_layer(lc):
+    """Translate one Keras-2 layer config into the Keras-1 vocabulary the
+    mappers consume (beyond the reference, which reads 1.x only — the h5
+    files in the wild are overwhelmingly 2.x)."""
+    cn = lc["class_name"]
+    cfg = dict(lc["config"])
+    if cfg.get("data_format") == "channels_first":
+        # channels_first would need CHW->HWC reordering of every downstream
+        # flattened kernel (Keras flattens NCHW tensors in CHW order) and the
+        # input shape often lives on a separate InputLayer; importing it
+        # silently would produce wrong predictions — reject loudly instead
+        raise ValueError(
+            "Keras 2.x channels_first models are not supported; re-save the "
+            "model with data_format='channels_last'")
+    if cn == "Dense" and "units" in cfg:
+        cfg["output_dim"] = cfg["units"]
+    elif cn == "Conv2D":
+        cn = "Convolution2D"
+        cfg["nb_filter"] = cfg["filters"]
+        cfg["nb_row"], cfg["nb_col"] = cfg["kernel_size"]
+        cfg["subsample"] = list(cfg.get("strides", (1, 1)))
+        cfg["border_mode"] = cfg.get("padding", "valid")
+        cfg["dilation"] = list(cfg.get("dilation_rate", (1, 1)))
+        # Keras-2 kernels are HWIO regardless of data_format: never transpose
+        cfg["dim_ordering"] = "tf"
+    elif cn in ("MaxPooling2D", "AveragePooling2D") and "padding" in cfg:
+        cfg["border_mode"] = cfg["padding"]
+    elif cn == "LSTM":
+        if "units" in cfg:
+            cfg["output_dim"] = cfg["units"]
+        if "recurrent_activation" in cfg:
+            cfg["inner_activation"] = cfg["recurrent_activation"]
+    elif cn == "Dropout" and "rate" in cfg:
+        cfg["p"] = cfg["rate"]
+    return {"class_name": cn, "config": cfg}
+
+
+def _normalize_keras2_config(config):
+    """Keras-2 Sequential model_config -> Keras-1-shaped layer list."""
+    layers = [_normalize_keras2_layer(lc) for lc in config["config"]["layers"]]
+    return {"class_name": "Sequential", "config": layers}
+
+
+def _normalize_keras2_weights(kl, weights):
+    """Keras-2 weight names (kernel:0/bias:0/...) -> the Keras-1 names the
+    assignment switch expects; Keras-2 LSTMs store FUSED kernels in gate
+    order [i|f|c|o], split back into per-gate matrices."""
+    ren = {"kernel:0": "W", "bias:0": "b", "gamma:0": "gamma",
+           "beta:0": "beta", "moving_mean:0": "running_mean",
+           "moving_variance:0": "running_std", "embeddings:0": "W"}
+    out = dict(weights)
+    if kl.class_name == "LSTM" and "kernel:0" in weights:
+        K = np.asarray(weights["kernel:0"])
+        R = np.asarray(weights["recurrent_kernel:0"])
+        b = np.asarray(weights["bias:0"])
+        u = K.shape[1] // 4
+        for idx, g in enumerate(("i", "f", "c", "o")):
+            out[f"W_{g}"] = K[:, idx * u:(idx + 1) * u]
+            out[f"U_{g}"] = R[:, idx * u:(idx + 1) * u]
+            out[f"b_{g}"] = b[idx * u:(idx + 1) * u]
+        return out
+    for k2, k1 in ren.items():
+        if k2 in weights:
+            out[k1] = weights[k2]
+    return out
+
+
 def _map_layers(keras_layers, enforce_training_config=False, loss=None):
     """Keras layer list -> (our layer conf list, input_type). Mirrors the
     per-type mappers in modelimport layers/Keras*.java."""
@@ -92,6 +168,7 @@ def _map_layers(keras_layers, enforce_training_config=False, loss=None):
                 n_out=cfg["nb_filter"],
                 kernel_size=(cfg["nb_row"], cfg["nb_col"]),
                 stride=tuple(cfg.get("subsample", (1, 1))),
+                dilation=tuple(cfg.get("dilation", (1, 1))),
                 convolution_mode="same" if border == "same" else "truncate",
                 activation=_act(cfg.get("activation"))))
         elif cn in ("MaxPooling2D", "AveragePooling2D"):
@@ -195,6 +272,7 @@ def _copy_weights(net, weights_root, layer_names, keras_layers):
         grp = weights_root[kname]
         wnames = grp.attrs.get("weight_names", [])
         weights = {wn.split("/")[-1]: np.asarray(grp[wn].value) for wn in wnames}
+        weights = _normalize_keras2_weights(kl, weights)
         _assign_layer_weights(net.params[str(our_idx)],
                               net.states[str(our_idx)], kl, weights)
         our_idx += 1
@@ -228,12 +306,20 @@ class KerasModelImport:
         if config["class_name"] != "Sequential":
             raise ValueError("not a Sequential model; use "
                              "import_keras_model_and_weights")
+        if _is_keras2_sequential(config):
+            config = _normalize_keras2_config(config)
         keras_layers = [KerasLayer(lc["class_name"], lc["config"])
                         for lc in config["config"]]
         training = None
         if "training_config" in root.attrs:
             training = json.loads(root.attrs["training_config"])
         loss = training.get("loss") if training else None
+        if isinstance(loss, dict):
+            # tf.keras serializes compiled loss OBJECTS as dicts; map the
+            # class name back to the snake_case loss identifier
+            import re as _re
+            loss = _re.sub(r"(?<!^)(?=[A-Z])", "_",
+                           loss.get("class_name", "")).lower()
 
         layers, input_type = _map_layers(keras_layers, loss=loss)
         from ..nn.conf.configuration import NeuralNetConfiguration
@@ -259,6 +345,10 @@ class KerasModelImport:
         if config["class_name"] == "Sequential":
             return KerasModelImport.import_keras_sequential_model_and_weights(
                 path, enforce_training_config)
+        if str(root.attrs.get("keras_version", "1")).startswith("2"):
+            raise ValueError(
+                "Keras 2.x functional models are not supported (Sequential "
+                "2.x and all 1.x layouts are); re-export as Sequential")
         return KerasModelImport._import_functional(root, config)
 
     @staticmethod
