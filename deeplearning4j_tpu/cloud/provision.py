@@ -63,8 +63,17 @@ class SshTransport(Transport):
     """ssh/scp subprocess transport (reference: HostProvisioner.java over
     jsch). Key-based auth only; no password prompts in automation."""
 
-    def __init__(self, user, key_file=None, ssh_opts=("-o", "BatchMode=yes",
-                                                      "-o", "StrictHostKeyChecking=no")):
+    def __init__(self, user, key_file=None, ssh_opts=None,
+                 strict_host_keys=True):
+        # accept-new pins first-seen host keys and refuses changed ones —
+        # this channel pipes uploaded scripts into bash, so a silent MITM
+        # must not be the default. Recycled-IP fleets (new VM, same address)
+        # opt out explicitly with strict_host_keys=False (or pass ssh_opts
+        # with a per-cluster UserKnownHostsFile).
+        if ssh_opts is None:
+            ssh_opts = ("-o", "BatchMode=yes", "-o",
+                        "StrictHostKeyChecking="
+                        + ("accept-new" if strict_host_keys else "no"))
         self.user = user
         self.key_file = key_file
         self.ssh_opts = list(ssh_opts)

@@ -1,4 +1,4 @@
-"""Flash attention forward as a Pallas TPU kernel.
+"""Flash attention forward AND backward as Pallas TPU kernels.
 
 The K/V stream tiles through VMEM with an online-softmax accumulator held in
 scratch, so the [Tq, Tk] score matrix never materializes in HBM — the same
@@ -6,8 +6,15 @@ math as parallel/ring_attention.py's blockwise path, but hand-scheduled:
 grid (batch*heads, q-blocks, k-blocks) with the k dimension innermost
 ("arbitrary" semantics) carrying (acc, m, l) scratch across iterations.
 
-Backward uses jax.custom_vjp with the reference-attention VJP (recompute; the
-fused backward kernel is future work — forward is the memory-bound hot op).
+Backward is fused and linear-memory: the forward additionally emits the
+per-row log-sum-exp (LSE) residual, and two backward kernels recompute the
+probability blocks from (q, k, lse) on the fly —
+  dQ    : grid (BH, q-blocks, k-blocks), k innermost, dq accumulated in VMEM
+  dK/dV : grid (BH, k-blocks, q-blocks), q innermost, dk/dv in VMEM
+so training never materializes [Tq, Tk] either. LSE and the dO·O row
+contraction are stored lane-broadcast ([BH, T, 128] f32, 512 B/row) — the
+layout Mosaic handles natively for row-vector operands (a plain [BH, T]
+residual would need a lane→sublane transpose inside the kernel).
 
 Falls back transparently (see `flash_attention`) when shapes don't tile or
 Pallas is unavailable, so callers can use it unconditionally.
@@ -22,8 +29,15 @@ import jax.numpy as jnp
 NEG_INF = -1e30
 
 
-def _flash_kernel(q_ref, k_ref, v_ref, o_ref, acc_ref, m_ref, l_ref, *,
-                  scale, causal, block_q, block_k, nk):
+LANES = 128  # lse/delta residuals are stored broadcast over one lane tile
+
+
+def _flash_kernel(q_ref, k_ref, v_ref, o_ref, *rest, scale, causal, block_q,
+                  block_k, nk, need_lse):
+    # rest = (lse_ref?, acc_ref, m_ref, l_ref) — lse output only exists on
+    # the vjp-forward path; inference skips the HBM write entirely
+    lse_ref = rest[0] if need_lse else None
+    acc_ref, m_ref, l_ref = rest[-3:]
     from jax.experimental import pallas as pl
     ki = pl.program_id(2)
     qi = pl.program_id(1)
@@ -71,22 +85,38 @@ def _flash_kernel(q_ref, k_ref, v_ref, o_ref, acc_ref, m_ref, l_ref, *,
         l = l_ref[:, :1]
         o_ref[0, ...] = (acc_ref[...] /
                          jnp.maximum(l, 1e-30)).astype(o_ref.dtype)
+        if need_lse:
+            lse_ref[0, ...] = m_ref[...] + jnp.log(
+                jnp.maximum(l_ref[...], 1e-30))
 
 
-def _flash_forward(q, k, v, scale, causal, block_q, block_k, interpret):
+def _fold_heads(x):
+    B, T, H, D = x.shape
+    return jnp.swapaxes(x, 1, 2).reshape(B * H, T, D)
+
+
+def _flash_forward(q, k, v, scale, causal, block_q, block_k, interpret,
+                   need_lse=False):
+    """Returns (out [B,Tq,H,D], lse [BH,Tq,LANES] f32 | None).
+
+    The LSE residual is emitted (written to HBM) only when `need_lse` —
+    inference-only calls skip that extra output-sized write."""
     from jax.experimental import pallas as pl
     from jax.experimental.pallas import tpu as pltpu
     B, Tq, H, D = q.shape
     Tk = k.shape[1]
     # fold heads into batch; kernel works on [BH, T, D]
-    qf = jnp.swapaxes(q, 1, 2).reshape(B * H, Tq, D)
-    kf = jnp.swapaxes(k, 1, 2).reshape(B * H, Tk, D)
-    vf = jnp.swapaxes(v, 1, 2).reshape(B * H, Tk, D)
+    qf, kf, vf = _fold_heads(q), _fold_heads(k), _fold_heads(v)
     nq = Tq // block_q
     nk = Tk // block_k
     kernel = functools.partial(_flash_kernel, scale=scale, causal=causal,
-                               block_q=block_q, block_k=block_k, nk=nk)
-    out = pl.pallas_call(
+                               block_q=block_q, block_k=block_k, nk=nk,
+                               need_lse=need_lse)
+    o_spec = pl.BlockSpec((1, block_q, D), lambda b, qi, ki: (b, qi, 0))
+    o_shape = jax.ShapeDtypeStruct((B * H, Tq, D), q.dtype)
+    lse_spec = pl.BlockSpec((1, block_q, LANES), lambda b, qi, ki: (b, qi, 0))
+    lse_shape = jax.ShapeDtypeStruct((B * H, Tq, LANES), jnp.float32)
+    res = pl.pallas_call(
         kernel,
         grid=(B * H, nq, nk),
         in_specs=[
@@ -94,37 +124,193 @@ def _flash_forward(q, k, v, scale, causal, block_q, block_k, interpret):
             pl.BlockSpec((1, block_k, D), lambda b, qi, ki: (b, ki, 0)),
             pl.BlockSpec((1, block_k, D), lambda b, qi, ki: (b, ki, 0)),
         ],
-        out_specs=pl.BlockSpec((1, block_q, D), lambda b, qi, ki: (b, qi, 0)),
-        out_shape=jax.ShapeDtypeStruct((B * H, Tq, D), q.dtype),
+        out_specs=[o_spec, lse_spec] if need_lse else [o_spec],
+        out_shape=[o_shape, lse_shape] if need_lse else [o_shape],
         scratch_shapes=[
-            pltpu.VMEM((block_q, D), jnp.float32),     # acc
-            pltpu.VMEM((block_q, 128), jnp.float32),   # running max
-            pltpu.VMEM((block_q, 128), jnp.float32),   # running sum
+            pltpu.VMEM((block_q, D), jnp.float32),       # acc
+            pltpu.VMEM((block_q, LANES), jnp.float32),   # running max
+            pltpu.VMEM((block_q, LANES), jnp.float32),   # running sum
         ],
         compiler_params=pltpu.CompilerParams(
             dimension_semantics=("parallel", "parallel", "arbitrary")),
         interpret=interpret,
     )(qf, kf, vf)
-    return jnp.swapaxes(out.reshape(B, H, Tq, D), 1, 2)
+    out = res[0]
+    lse = res[1] if need_lse else None
+    return jnp.swapaxes(out.reshape(B, H, Tq, D), 1, 2), lse
+
+
+def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
+                   dq_acc, *, scale, causal, block_q, block_k, nk):
+    from jax.experimental import pallas as pl
+    ki = pl.program_id(2)
+    qi = pl.program_id(1)
+
+    @pl.when(ki == 0)
+    def _init():
+        dq_acc[...] = jnp.zeros_like(dq_acc)
+
+    def _accumulate():
+        q = q_ref[0].astype(jnp.float32)          # [bq, d]
+        k = k_ref[0].astype(jnp.float32)          # [bk, d]
+        v = v_ref[0].astype(jnp.float32)
+        do = do_ref[0].astype(jnp.float32)        # [bq, d]
+        lse = lse_ref[0][:, :1]                   # [bq, 1]
+        delta = delta_ref[0][:, :1]               # [bq, 1]
+        s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
+                                preferred_element_type=jnp.float32) * scale
+        if causal:
+            qpos = qi * block_q + jax.lax.broadcasted_iota(jnp.int32, s.shape, 0)
+            kpos = ki * block_k + jax.lax.broadcasted_iota(jnp.int32, s.shape, 1)
+            s = jnp.where(kpos > qpos, NEG_INF, s)
+        p = jnp.exp(s - lse)                      # [bq, bk]
+        dp = jax.lax.dot_general(do, v, (((1,), (1,)), ((), ())),
+                                 preferred_element_type=jnp.float32)
+        ds = p * (dp - delta) * scale             # [bq, bk]
+        dq_acc[...] += jax.lax.dot_general(
+            ds, k, (((1,), (0,)), ((), ())), preferred_element_type=jnp.float32)
+
+    if causal:
+        pl.when(ki * block_k <= (qi + 1) * block_q - 1)(_accumulate)
+    else:
+        _accumulate()
+
+    @pl.when(ki == nk - 1)
+    def _finish():
+        dq_ref[0, ...] = dq_acc[...].astype(dq_ref.dtype)
+
+
+def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+                    dk_ref, dv_ref, dk_acc, dv_acc, *, scale, causal,
+                    block_q, block_k, nq):
+    from jax.experimental import pallas as pl
+    ki = pl.program_id(1)
+    qi = pl.program_id(2)
+
+    @pl.when(qi == 0)
+    def _init():
+        dk_acc[...] = jnp.zeros_like(dk_acc)
+        dv_acc[...] = jnp.zeros_like(dv_acc)
+
+    def _accumulate():
+        q = q_ref[0].astype(jnp.float32)          # [bq, d]
+        k = k_ref[0].astype(jnp.float32)          # [bk, d]
+        v = v_ref[0].astype(jnp.float32)
+        do = do_ref[0].astype(jnp.float32)        # [bq, d]
+        lse = lse_ref[0][:, :1]                   # [bq, 1]
+        delta = delta_ref[0][:, :1]
+        s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
+                                preferred_element_type=jnp.float32) * scale
+        if causal:
+            qpos = qi * block_q + jax.lax.broadcasted_iota(jnp.int32, s.shape, 0)
+            kpos = ki * block_k + jax.lax.broadcasted_iota(jnp.int32, s.shape, 1)
+            s = jnp.where(kpos > qpos, NEG_INF, s)
+        p = jnp.exp(s - lse)                      # [bq, bk]
+        # dV += Pᵀ·dO ; dK += dSᵀ·Q  (contract over the q rows)
+        dv_acc[...] += jax.lax.dot_general(
+            p, do, (((0,), (0,)), ((), ())), preferred_element_type=jnp.float32)
+        dp = jax.lax.dot_general(do, v, (((1,), (1,)), ((), ())),
+                                 preferred_element_type=jnp.float32)
+        ds = p * (dp - delta) * scale
+        dk_acc[...] += jax.lax.dot_general(
+            ds, q, (((0,), (0,)), ((), ())), preferred_element_type=jnp.float32)
+
+    if causal:
+        # skip q blocks strictly above the diagonal: every row there masks
+        # this whole k block ((qi+1)*bq - 1 < ki*bk)
+        pl.when((qi + 1) * block_q - 1 >= ki * block_k)(_accumulate)
+    else:
+        _accumulate()
+
+    @pl.when(qi == nq - 1)
+    def _finish():
+        dk_ref[0, ...] = dk_acc[...].astype(dk_ref.dtype)
+        dv_ref[0, ...] = dv_acc[...].astype(dv_ref.dtype)
+
+
+def _flash_backward(q, k, v, out, lse, g, scale, causal, block_q, block_k,
+                    interpret):
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+    B, Tq, H, D = q.shape
+    Tk = k.shape[1]
+    nq, nk = Tq // block_q, Tk // block_k
+    qf, kf, vf = _fold_heads(q), _fold_heads(k), _fold_heads(v)
+    dof = _fold_heads(g)
+    # delta_i = Σ_d dO_id · O_id, lane-broadcast like lse (see module doc)
+    delta = jnp.sum(dof.astype(jnp.float32) * _fold_heads(out).astype(jnp.float32),
+                    axis=-1)
+    delta = jnp.broadcast_to(delta[..., None], (B * H, Tq, LANES))
+
+    lane_spec = pl.BlockSpec((1, block_q, LANES), lambda b, qi, ki: (b, qi, 0))
+    dq = pl.pallas_call(
+        functools.partial(_bwd_dq_kernel, scale=scale, causal=causal,
+                          block_q=block_q, block_k=block_k, nk=nk),
+        grid=(B * H, nq, nk),
+        in_specs=[
+            pl.BlockSpec((1, block_q, D), lambda b, qi, ki: (b, qi, 0)),
+            pl.BlockSpec((1, block_k, D), lambda b, qi, ki: (b, ki, 0)),
+            pl.BlockSpec((1, block_k, D), lambda b, qi, ki: (b, ki, 0)),
+            pl.BlockSpec((1, block_q, D), lambda b, qi, ki: (b, qi, 0)),
+            lane_spec,
+            lane_spec,
+        ],
+        out_specs=pl.BlockSpec((1, block_q, D), lambda b, qi, ki: (b, qi, 0)),
+        out_shape=jax.ShapeDtypeStruct((B * H, Tq, D), q.dtype),
+        scratch_shapes=[pltpu.VMEM((block_q, D), jnp.float32)],
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "parallel", "arbitrary")),
+        interpret=interpret,
+    )(qf, kf, vf, dof, lse, delta)
+
+    qlane = pl.BlockSpec((1, block_q, LANES), lambda b, ki, qi: (b, qi, 0))
+    dk, dv = pl.pallas_call(
+        functools.partial(_bwd_dkv_kernel, scale=scale, causal=causal,
+                          block_q=block_q, block_k=block_k, nq=nq),
+        grid=(B * H, nk, nq),
+        in_specs=[
+            pl.BlockSpec((1, block_q, D), lambda b, ki, qi: (b, qi, 0)),
+            pl.BlockSpec((1, block_k, D), lambda b, ki, qi: (b, ki, 0)),
+            pl.BlockSpec((1, block_k, D), lambda b, ki, qi: (b, ki, 0)),
+            pl.BlockSpec((1, block_q, D), lambda b, ki, qi: (b, qi, 0)),
+            qlane,
+            qlane,
+        ],
+        out_specs=[
+            pl.BlockSpec((1, block_k, D), lambda b, ki, qi: (b, ki, 0)),
+            pl.BlockSpec((1, block_k, D), lambda b, ki, qi: (b, ki, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((B * H, Tk, D), k.dtype),
+            jax.ShapeDtypeStruct((B * H, Tk, D), v.dtype),
+        ],
+        scratch_shapes=[pltpu.VMEM((block_k, D), jnp.float32),
+                        pltpu.VMEM((block_k, D), jnp.float32)],
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "parallel", "arbitrary")),
+        interpret=interpret,
+    )(qf, kf, vf, dof, lse, delta)
+
+    unfold = lambda x, T: jnp.swapaxes(x.reshape(B, H, T, D), 1, 2)
+    return unfold(dq, Tq), unfold(dk, Tk), unfold(dv, Tk)
 
 
 @functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7))
 def _flash(q, k, v, scale, causal, block_q, block_k, interpret):
-    return _flash_forward(q, k, v, scale, causal, block_q, block_k, interpret)
+    return _flash_forward(q, k, v, scale, causal, block_q, block_k,
+                          interpret)[0]
 
 
 def _flash_fwd(q, k, v, scale, causal, block_q, block_k, interpret):
-    out = _flash_forward(q, k, v, scale, causal, block_q, block_k, interpret)
-    return out, (q, k, v)
+    out, lse = _flash_forward(q, k, v, scale, causal, block_q, block_k,
+                              interpret, need_lse=True)
+    return out, (q, k, v, out, lse)
 
 
 def _flash_bwd(scale, causal, block_q, block_k, interpret, res, g):
-    from ..parallel.ring_attention import attention_reference
-    q, k, v = res
-    _, vjp = jax.vjp(
-        lambda q_, k_, v_: attention_reference(q_, k_, v_, causal=causal,
-                                               scale=scale), q, k, v)
-    return vjp(g)
+    q, k, v, out, lse = res
+    return _flash_backward(q, k, v, out, lse, g, scale, causal, block_q,
+                           block_k, interpret)
 
 
 _flash.defvjp(_flash_fwd, _flash_bwd)
@@ -145,7 +331,14 @@ def flash_attention(q, k, v, *, causal=False, scale=None, block_q=128,
         interpret = jax.default_backend() != "tpu"
     block_q = min(block_q, Tq)
     block_k = min(block_k, Tk)
-    if Tq % block_q or Tk % block_k or D % 8:
+    # divisibility alone isn't enough when compiling: Mosaic requires
+    # tile-aligned blocks (sublane dim multiple of 8, lane dim multiple of
+    # 128 — the score tile is [block_q, block_k]); e.g. Tq=100 divides into
+    # one 100-row block but would be rejected at TPU compile time. Interpret
+    # mode (CPU tests) has no such constraint, so small blocks stay allowed
+    # there to keep kernel-logic tests cheap.
+    misaligned = not interpret and (block_q % 8 or block_k % 128)
+    if Tq % block_q or Tk % block_k or D % 8 or misaligned:
         from ..parallel.ring_attention import attention_reference
         return attention_reference(q, k, v, causal=causal, scale=scale)
     return _flash(q, k, v, scale, causal, block_q, block_k, interpret)

@@ -34,8 +34,9 @@ class KerasGatewayServer(BackgroundHttpServer):
         super().__init__(host=host, port=port)
         self.models = {}
         self._fit_counts = {}
+        self._model_locks = {}
         self._next_id = 0
-        self._lock = threading.Lock()
+        self._lock = threading.Lock()  # registry mutation + snapshot reads only
 
     # ------------------------------------------------------------ entry points
     def register_model(self, h5_bytes: bytes) -> str:
@@ -54,28 +55,35 @@ class KerasGatewayServer(BackgroundHttpServer):
             self._next_id += 1
             self.models[mid] = net
             self._fit_counts[mid] = 0
+            self._model_locks[mid] = threading.Lock()
         return mid
+
+    def _model_and_lock(self, mid):
+        with self._lock:
+            return self.models[mid], self._model_locks[mid]
 
     def fit(self, mid, features, labels, epochs=1, batch_size=32):
         """(reference: DeepLearning4jEntryPoint.fit — N epochs over the
-        minibatched arrays). Serialized under the gateway lock: the HTTP
+        minibatched arrays). Serialized under a PER-MODEL lock: the HTTP
         server is threaded and concurrent fit/predict on one model would race
-        on its parameters."""
+        on its parameters — but a long fit on model A must not block model B."""
         from ..datasets.dataset import DataSet
         from ..datasets.iterator.base import ListDataSetIterator
-        with self._lock:
-            net = self.models[mid]
+        net, mlock = self._model_and_lock(mid)
+        with mlock:
             ds = DataSet(np.asarray(features, np.float32),
                          np.asarray(labels, np.float32))
             it = ListDataSetIterator(ds, batch_size=int(batch_size))
             net.fit(it, epochs=int(epochs))
-            self._fit_counts[mid] += int(epochs)
-            return {"epochs_fit": self._fit_counts[mid],
+            with self._lock:
+                self._fit_counts[mid] += int(epochs)
+                total = self._fit_counts[mid]
+            return {"epochs_fit": total,
                     "score": float(net.score_value)}
 
     def predict(self, mid, features):
-        with self._lock:
-            net = self.models[mid]
+        net, mlock = self._model_and_lock(mid)
+        with mlock:
             return np.asarray(net.output(np.asarray(features, np.float32)))
 
     # ---------------------------------------------------------------- server
@@ -91,13 +99,15 @@ class KerasGatewayServer(BackgroundHttpServer):
                 m = route.match(self.path)
                 if m and not m.group(2):
                     mid = m.group(1)
-                    if mid not in gw.models:
+                    with gw._lock:
+                        net = gw.models.get(mid)
+                        epochs_fit = gw._fit_counts.get(mid, 0)
+                    if net is None:
                         self._send(404, {"error": "unknown model"})
                         return
-                    net = gw.models[mid]
                     self._send(200, {"model_id": mid,
                                      "n_params": int(net.num_params()),
-                                     "epochs_fit": gw._fit_counts[mid]})
+                                     "epochs_fit": epochs_fit})
                 else:
                     self._send(404, {"error": "not found"})
 

@@ -2,8 +2,11 @@
 
 Reference: the reference reads Keras h5 files through the native HDF5 C
 library via JavaCPP (modelimport Hdf5Archive.java:22-35, SURVEY.md §2.9 #5).
-This environment ships no h5py, so this module implements the HDF5 v1 file
-format subset that Keras 1.x files use:
+This module is a dependency-free fallback (and the format-level spec of what
+the importer relies on): it implements the HDF5 v1 file format subset Keras
+1.x files use, with no native library. h5py IS available in this environment
+and the test fixtures are written with it — hdf5_lite is what `modelimport`
+uses at runtime so importing a model never requires the native HDF5 stack:
 
 - superblock v0, v1 object headers (+ continuation blocks)
 - old-style groups: symbol-table message -> v1 B-tree -> SNOD + local heap

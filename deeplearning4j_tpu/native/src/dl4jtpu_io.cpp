@@ -19,8 +19,46 @@
 #include <atomic>
 #include <thread>
 #include <vector>
+#include <locale.h>
 
 extern "C" {
+
+// ------------------------------------------------------------ numerics ----
+// The fast path must produce EXACTLY what Python's float() would, or defer.
+// strtod alone can't guarantee that: it is LC_NUMERIC-dependent (decimal
+// comma locales) and accepts hex floats ("0x1p3") and "nan(chars)" that
+// float() spells differently or rejects. So fields are first validated
+// against the strict decimal grammar  [+-]?(d+[.d*]|.d+)([eE][+-]?d+)?
+// (hex / inf / nan / underscores all fail -> caller falls back to the
+// Python parser, which handles them consistently), then converted with
+// strtod_l under a pinned "C" locale for exact double parity.
+static locale_t c_locale() {
+    static locale_t loc = newlocale(LC_ALL_MASK, "C", (locale_t)0);
+    return loc;
+}
+
+// returns length of the valid strict-decimal prefix ending at delim/EOL,
+// or -1 if the field (up to delim/'\n'/'\r') is not strict-decimal
+static int64_t strict_decimal_len(const char* p, int64_t len, char delim) {
+    int64_t i = 0;
+    if (i < len && (p[i] == '+' || p[i] == '-')) ++i;
+    int64_t digits = 0, frac_digits = 0;
+    while (i < len && p[i] >= '0' && p[i] <= '9') { ++i; ++digits; }
+    if (i < len && p[i] == '.') {
+        ++i;
+        while (i < len && p[i] >= '0' && p[i] <= '9') { ++i; ++frac_digits; }
+    }
+    if (digits + frac_digits == 0) return -1;
+    if (i < len && (p[i] == 'e' || p[i] == 'E')) {
+        ++i;
+        if (i < len && (p[i] == '+' || p[i] == '-')) ++i;
+        int64_t exp_digits = 0;
+        while (i < len && p[i] >= '0' && p[i] <= '9') { ++i; ++exp_digits; }
+        if (exp_digits == 0) return -1;
+    }
+    if (i < len && p[i] != delim && p[i] != '\n' && p[i] != '\r') return -1;
+    return i;
+}
 
 // ---------------------------------------------------------------- CSV -----
 // Parse a numeric CSV buffer into a dense float64 matrix (row-major).
@@ -45,18 +83,24 @@ int dl4j_csv_parse(const char* buf, int64_t len, char delim, int64_t skip,
         if (buf[i] == '\n' || buf[i] == '\r') { ++i; continue; }
         int64_t line_cols = 0;
         while (i < len && buf[i] != '\n') {
-            // parse one field
-            char* end = nullptr;
-            // strtod stops at delimiter/newline; give it a bounded view by
-            // relying on the delimiter not being numeric
-            double v = strtod(buf + i, &end);
-            if (end == buf + i) return -2;  // non-numeric field
-            i = end - buf;
-            // the number must be followed by a delimiter/EOL: a field like
-            // "1 2" (internal whitespace) is a STRING to the Python parser
-            // and must defer, not silently split into two values
-            if (i < len && buf[i] != delim && buf[i] != '\n' && buf[i] != '\r')
-                return -2;
+            // parse one field: validate strict decimal grammar first (see
+            // strict_decimal_len), then convert locale-pinned
+            int64_t flen = strict_decimal_len(buf + i, len - i, delim);
+            if (flen <= 0) return -2;  // non-numeric / non-strict field
+            char tmp[64];
+            double v;
+            if (flen < (int64_t)sizeof(tmp)) {
+                memcpy(tmp, buf + i, flen);
+                tmp[flen] = '\0';
+                char* end = nullptr;
+                v = strtod_l(tmp, &end, c_locale());
+                if (end != tmp + flen) return -2;
+            } else {
+                return -2;  // absurdly long field: defer to Python
+            }
+            i += flen;
+            // (strict_decimal_len guarantees buf[i] is delim/EOL/EOF here —
+            // e.g. "1 2" with internal whitespace already deferred above)
             if (out) out[write] = v;
             ++write;
             ++line_cols;

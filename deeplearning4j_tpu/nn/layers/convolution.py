@@ -184,15 +184,23 @@ class BatchNormalizationModule(BaseLayerModule):
                 var = jnp.mean(jnp.square(x - mean), axis=axes, dtype=stat_dt)
             else:
                 # mixed-precision path: one-pass shifted variance
-                # E[(x−μ₀)²] − (E[x]−μ₀)² with μ₀ = running mean, so both
-                # reductions fuse into a single read of the bf16 activation
-                # (the two-pass form re-reads x and materializes a full-size
-                # centered temp; ~40 ms/step across ResNet-50's 53 BN layers).
-                # The shift keeps the squared terms near zero, avoiding the
-                # catastrophic cancellation a raw E[x²]−E[x]² suffers when
-                # |mean| >> std; the subtraction promotes to f32 elementwise
-                # and fuses, so no extra HBM traffic.
-                mu0 = lax.stop_gradient(state["mean"])
+                # E[(x−μ₀)²] − (E[x]−μ₀)² so both reductions fuse into a
+                # single read of the bf16 activation (the two-pass form
+                # re-reads x; ~40 ms/step across ResNet-50's 53 BN layers).
+                # μ₀ is the mean of a strided subsample of THIS batch — it
+                # lands within O(std/√n_sub) of the true mean, so the shifted
+                # second moment has the same magnitude as the variance itself
+                # and f32 rounding stays relative to var (a μ₀ far from the
+                # data — e.g. the running mean at step 0, zeros — degenerates
+                # to E[x²]−E[x]² and cancels catastrophically when
+                # |mean| >> std). The subsample is a slice, so its reduction
+                # reads a fraction of x and fuses alongside the main pass.
+                sub = x[(slice(None),) + tuple(
+                    slice(None, None, max(1, x.shape[a] // 8))
+                    for a in range(1, x.ndim - 1))]
+                mu0 = lax.stop_gradient(
+                    jnp.mean(sub, axis=tuple(range(sub.ndim - 1)),
+                             dtype=stat_dt))
                 d = x.astype(stat_dt) - mu0
                 ex2c = jnp.mean(jnp.square(d), axis=axes, dtype=stat_dt)
                 var = jnp.maximum(ex2c - jnp.square(mean - mu0), 0.0)

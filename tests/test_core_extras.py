@@ -296,17 +296,11 @@ def test_quadtree_structure_and_forces():
     assert abs(sum_qa - q.sum()) / q.sum() < 0.1
 
 
-def test_keras_gateway_server(tmp_path):
-    """HTTP gateway serving the Keras-backend entry points (reference:
-    deeplearning4j-keras Server.java + DeepLearning4jEntryPoint.fit)."""
+def _gateway_h5(tmp_path):
+    """Small Keras-1.x h5 (same layout the importer reads) for gateway tests."""
     import json as _json
-    import urllib.request
     import numpy as np
-    from deeplearning4j_tpu.modelimport.gateway import KerasGatewayServer
-    from deeplearning4j_tpu.streaming.serde import serialize_array
     from deeplearning4j_tpu.modelimport import hdf5_lite
-
-    # build a small Keras-1.x h5 (same layout the importer reads)
     rng = np.random.default_rng(4)
     W1 = rng.normal(size=(4, 8), scale=0.4).astype(np.float32)
     b1 = np.zeros(8, np.float32)
@@ -333,7 +327,20 @@ def test_keras_gateway_server(tmp_path):
         g.create_dataset(f"{name}_b", b)
     h5p = tmp_path / "gw.h5"
     f.save(h5p)
+    return h5p
 
+
+def test_keras_gateway_server(tmp_path):
+    """HTTP gateway serving the Keras-backend entry points (reference:
+    deeplearning4j-keras Server.java + DeepLearning4jEntryPoint.fit)."""
+    import json as _json
+    import urllib.request
+    import numpy as np
+    from deeplearning4j_tpu.modelimport.gateway import KerasGatewayServer
+    from deeplearning4j_tpu.streaming.serde import serialize_array
+
+    rng = np.random.default_rng(4)
+    h5p = _gateway_h5(tmp_path)
     srv = KerasGatewayServer(port=0).start()
     try:
         def post(path, data, raw=False):
@@ -358,6 +365,60 @@ def test_keras_gateway_server(tmp_path):
         with urllib.request.urlopen(srv.url + f"/models/{mid}", timeout=10) as r:
             info = _json.loads(r.read())
         assert info["n_params"] == 4*8 + 8 + 8*3 + 3
+    finally:
+        srv.stop()
+
+
+def test_keras_gateway_per_model_locks(tmp_path):
+    """A long fit on model A must not block predict on model B (per-model
+    locks; one global lock only guards registry mutation)."""
+    import json as _json
+    import threading
+    import time as _time
+    import urllib.request
+    import numpy as np
+    from deeplearning4j_tpu.modelimport.gateway import KerasGatewayServer
+    from deeplearning4j_tpu.streaming.serde import serialize_array
+
+    h5p = _gateway_h5(tmp_path)
+    srv = KerasGatewayServer(port=0).start()
+    try:
+        def post(path, data):
+            req = urllib.request.Request(srv.url + path, data=data)
+            with urllib.request.urlopen(req, timeout=120) as r:
+                return _json.loads(r.read())
+
+        h5 = open(h5p, "rb").read()
+        ma = post("/models", h5)["model_id"]
+        mb = post("/models", h5)["model_id"]
+        rng = np.random.default_rng(0)
+        X = rng.normal(size=(512, 4)).astype(np.float32)
+        Y = np.eye(3, dtype=np.float32)[rng.integers(0, 3, 512)]
+        pred_body = _json.dumps(
+            {"features": _json.loads(serialize_array(X[:4]))}).encode()
+        post(f"/models/{mb}/predict", pred_body)  # warm B's compile cache
+
+        fit_secs = [0.0]
+
+        def fit_a():
+            t0 = _time.monotonic()
+            post(f"/models/{ma}/fit", _json.dumps(
+                {"features": _json.loads(serialize_array(X)),
+                 "labels": _json.loads(serialize_array(Y)),
+                 "epochs": 40, "batch_size": 8}).encode())
+            fit_secs[0] = _time.monotonic() - t0
+
+        th = threading.Thread(target=fit_a)
+        th.start()
+        _time.sleep(0.2)  # let the fit take its model lock
+        t0 = _time.monotonic()
+        out = post(f"/models/{mb}/predict", pred_body)
+        pred_sec = _time.monotonic() - t0
+        th.join()
+        assert out["shape"] == [4, 3]
+        # with the old global lock, predict waits the whole fit out
+        assert pred_sec < max(0.5, fit_secs[0] / 2), \
+            f"predict ({pred_sec:.2f}s) blocked behind fit ({fit_secs[0]:.2f}s)"
     finally:
         srv.stop()
 

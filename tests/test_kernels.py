@@ -53,6 +53,51 @@ def test_flash_attention_gradients_match_reference():
                                    rtol=2e-4, atol=2e-5)
 
 
+@pytest.mark.parametrize("causal", [False, True])
+def test_flash_fused_backward_multi_block_asymmetric(causal):
+    """The fused Pallas backward (dq/dk/dv kernels, no [Tq,Tk] materialized)
+    must match the reference VJP across several blocks each way and Tq != Tk."""
+    rng = np.random.default_rng(11)
+    q = jnp.asarray(rng.normal(size=(2, 64, 2, 16)).astype(np.float32))
+    k = jnp.asarray(rng.normal(size=(2, 96, 2, 16)).astype(np.float32))
+    v = jnp.asarray(rng.normal(size=(2, 96, 2, 16)).astype(np.float32))
+    if causal:
+        k, v = k[:, :64], v[:, :64]  # causal requires Tq == Tk semantics
+    ct = jnp.asarray(rng.normal(size=(2, 64, 2, 16)).astype(np.float32))
+
+    def run(fn):
+        out, vjp = jax.vjp(lambda a, b, c: fn(a, b, c), q, k, v)
+        return out, vjp(ct)
+
+    out_f, gf = run(lambda a, b, c: flash_attention(
+        a, b, c, causal=causal, block_q=16, block_k=32))
+    out_r, gr = run(lambda a, b, c: attention_reference(a, b, c, causal=causal))
+    np.testing.assert_allclose(np.asarray(out_f), np.asarray(out_r),
+                               rtol=2e-5, atol=2e-6)
+    for name, a, b in zip("q k v".split(), gf, gr):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=2e-4, atol=2e-5, err_msg=f"d{name}")
+
+
+def test_flash_fused_backward_bf16():
+    q, k, v = _qkv(t=32, d=16)
+    qb, kb, vb = (x.astype(jnp.bfloat16) for x in (q, k, v))
+
+    def loss(fn, *args):
+        return jnp.sum(fn(*args).astype(jnp.float32) ** 2)
+
+    gf = jax.grad(lambda a, b, c: loss(
+        lambda *t: flash_attention(*t, causal=True, block_q=16, block_k=16),
+        a, b, c), argnums=(0, 1, 2))(qb, kb, vb)
+    gr = jax.grad(lambda a, b, c: loss(
+        lambda *t: attention_reference(*t, causal=True), a, b, c),
+        argnums=(0, 1, 2))(q, k, v)
+    for a, b in zip(gf, gr):
+        assert a.dtype == jnp.bfloat16
+        np.testing.assert_allclose(np.asarray(a, dtype=np.float32),
+                                   np.asarray(b), rtol=1e-1, atol=1e-1)
+
+
 def test_flash_attention_fallback_on_ragged_seq():
     # T=50 doesn't tile into 16-blocks -> silently uses the reference path
     rng = np.random.default_rng(7)

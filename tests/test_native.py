@@ -105,3 +105,31 @@ def test_csv_trailing_delimiter_falls_back():
 def test_csv_internal_whitespace_falls_back():
     # "1 2" is a string field to the Python parser; native must defer
     assert native.csv_parse(b"1 2\n3 4\n") is None
+
+
+def test_csv_strict_grammar_defers_nonportable_spellings():
+    # strtod would accept all of these, but they are either locale-dependent,
+    # spelled differently by Python float(), or rejected by it — the native
+    # path must defer to the Python parser (which handles them consistently)
+    assert native.csv_parse(b"0x10,2\n") is None          # hex float
+    assert native.csv_parse(b"0x1p3,2\n") is None         # hex exponent
+    assert native.csv_parse(b"inf,2\n") is None           # float('inf') ok, but defer
+    assert native.csv_parse(b"infinity,2\n") is None
+    assert native.csv_parse(b"nan,2\n") is None
+    assert native.csv_parse(b"NAN(chars),2\n") is None    # strtod-only spelling
+    assert native.csv_parse(b"1_0,2\n") is None           # float('1_0')==10.0
+    assert native.csv_parse(b" 1.5,2\n") is None          # leading space: strip()ed by Python
+    # strict decimal forms all still take the fast path, exact parity
+    m = native.csv_parse(b"1.,.5,-3e-2,+4E+1,16777217\n")
+    assert m is not None
+    assert m.tolist() == [[float("1."), float(".5"), float("-3e-2"),
+                           float("+4E+1"), float("16777217")]]
+
+
+def test_csv_int_looking_fields_take_fast_path_as_floats():
+    # documented all-float contract: the Python fallback's _coerce also
+    # returns float for int-looking fields, so the paths agree
+    from deeplearning4j_tpu.datasets.records.reader import _coerce
+    m = native.csv_parse(b"1,2,3\n")
+    assert m is not None and m.tolist() == [[1.0, 2.0, 3.0]]
+    assert [_coerce(v) for v in "1,2,3".split(",")] == [1.0, 2.0, 3.0]
