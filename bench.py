@@ -6,21 +6,33 @@ Headline metric: ResNet-50 ComputationGraph.fit() samples/sec/chip (BASELINE
 config #2 / north star), bf16 mixed precision (f32 master params/BN/loss).
 Extras carry the other four BASELINE configs (LeNet #1, GravesLSTM char-RNN
 #3, multi-replica scaling #4 measured on a virtual CPU mesh subprocess,
-Word2Vec #5) plus an END-TO-END number through fit(DataSetIterator) with
-uint8-on-the-wire input and device prefetch (VERDICT r3 items #2/#3).
+Word2Vec #5), an END-TO-END number through fit(DataSetIterator) with
+uint8-on-the-wire input and device prefetch, the transformer LM (tokens/sec)
+and the Pallas flash-attention kernel fwd/bwd vs the reference einsum path.
 
-Roofline context (measured on this rig, reported as extras): the axon-relay
-v5e sustains ~124 TFLOP/s bf16 matmul (63% of 197 nominal) and ~123 GB/s
-effective HBM bandwidth (~15% of nominal 820). ResNet-50 training at bf16 is
-activation-bandwidth-bound at that link rate, so `mfu` (vs 197e12 nominal) is
-reported next to `roofline_util` (vs the measured ceilings) — the latter is
-the honest utilization of the hardware actually reachable from this process.
+Roofline methodology (PERF.md carries the full dossier):
+ - Ceilings are measured with the probe INSIDE one executable (lax.scan of
+   chained matmuls / elementwise passes) so per-launch dispatch and readback
+   latency through the axon relay cannot pollute the number. Measured this
+   way the chip sustains ~170 TF/s bf16 matmul (86% of 197 nominal) and
+   ~680 GB/s elementwise HBM streams (83% of 820 nominal). (Round-3 numbers
+   — 66 TF/s / 83 GB/s — timed K separate dispatches against a ~100 ms
+   readback floor and were relay artifacts, not chip ceilings.)
+ - Per-step work is XLA's own accounting of the compiled train step:
+   Compiled.cost_analysis() flops and bytes-accessed (fusions count external
+   operands/outputs only, so bytes-accessed is an upper bound on HBM
+   traffic that ignores any cache reuse).
+ - roofline_util = max(flops/tf_ceiling, bytes/bw_ceiling) / measured step
+   time: utilization of the BINDING resource (`roofline_binding` names it).
+   A value near (or above) 1.0 means the step extracts the hardware's
+   measured ceiling for its dominant resource; >1.0 is possible because
+   bytes-accessed overestimates true traffic.
 
-Methodology (remote-attached TPU): dispatch is async and block_until_ready can
-be a no-op through the PJRT relay, so the only trustworthy fence is a
-device->host readback; K steps are bracketed by readbacks and the readback
-latency floor is subtracted. The train step itself never syncs (score stays on
-device).
+Timing methodology (remote-attached TPU): dispatch is async and
+block_until_ready can be a no-op through the PJRT relay, so the only
+trustworthy fence is a device->host readback; K steps are bracketed by
+readbacks and the readback latency floor is subtracted. The train step itself
+never syncs (score stays on device).
 """
 from __future__ import annotations
 
@@ -35,6 +47,7 @@ import numpy as np
 
 ASSUMED_BASELINE_SAMPLES_PER_SEC = 1000.0
 V5E_PEAK_FLOPS = 197e12          # bf16 dense nominal, TPU v5e
+V5E_PEAK_HBM = 820e9             # bytes/s nominal, TPU v5e
 RESNET50_FLOPS_PER_SAMPLE = 3 * 4.09e9  # fwd+bwd ~= 3x fwd @224^2
 
 
@@ -43,7 +56,7 @@ def _sync(x):
     return np.asarray(jax.device_get(x))
 
 
-def _readback_floor_ms(reps=3):
+def _readback_floor_ms(reps=5):
     import jax.numpy as jnp
     t = []
     for _ in range(reps):
@@ -54,38 +67,86 @@ def _readback_floor_ms(reps=3):
     return min(t) * 1e3
 
 
+def _best_of(trials, timed_run):
+    """Min over `trials` invocations of timed_run() -> elapsed seconds. The
+    relay's dispatch latency comes in multi-second bad phases (r04 saw the
+    same LeNet loop at 1.3 ms/step and 21 ms/step an hour apart); the min is
+    the honest estimate of the step cost itself."""
+    return min(timed_run() for _ in range(trials))
+
+
+def _time_steps(run_step, steps, fence, trials=3):
+    """Best-of-`trials` seconds for `steps` calls of run_step(i), each trial
+    fenced by a device->host readback (`fence`)."""
+    def timed():
+        t0 = time.perf_counter()
+        for i in range(steps):
+            run_step(i)
+        fence()
+        return time.perf_counter() - t0
+    return _best_of(trials, timed)
+
+
 def _measure_ceilings():
-    """Measured roofline of this chip+relay: bf16 matmul TFLOP/s and
-    effective HBM GB/s (elementwise read+write)."""
+    """Measured roofline ceilings of this chip: bf16 matmul TFLOP/s and
+    elementwise HBM GB/s, with the K-iteration probe inside ONE executable
+    (lax.scan) so the relay's per-dispatch latency is amortized to zero."""
     import jax
     import jax.numpy as jnp
-    A = jnp.ones((8192, 8192), jnp.bfloat16)
+    from jax import lax
+    floor = _readback_floor_ms() / 1e3
+
+    M, KM = 8192, 40
+    A = jnp.ones((M, M), jnp.bfloat16)
 
     @jax.jit
-    def mm(a, b):
-        return jnp.dot(a, b).astype(jnp.bfloat16)
-    C = mm(A, A)
-    _sync(C[0, 0])
-    t0 = time.perf_counter()
-    C = A
-    for _ in range(10):
-        C = mm(C, A)
-    _sync(C[0, 0])
-    tf = 2 * 8192 ** 3 / ((time.perf_counter() - t0) / 10)
+    def mm_scan(a):
+        def body(c, _):
+            c = jnp.dot(c, a, preferred_element_type=jnp.bfloat16)
+            return (c * 1e-4).astype(jnp.bfloat16), ()
+        out, _ = lax.scan(body, a, None, length=KM)
+        return out[0, 0]
+
+    _sync(mm_scan(A))  # compile
+
+    def timed_mm():
+        t0 = time.perf_counter()
+        _sync(mm_scan(A))
+        return time.perf_counter() - t0
+
+    tf = 2 * M ** 3 * KM / max(_best_of(3, timed_mm) - floor, 1e-9)
 
     x = jnp.ones((256, 1024, 1024), jnp.bfloat16)  # 512 MiB
+    KB = 100
 
     @jax.jit
-    def ew(x):
-        return x * 1.0001 + 1.0
-    y = ew(x)
-    _sync(y.ravel()[0])
-    t0 = time.perf_counter()
-    for _ in range(10):
-        y = ew(y)
-    _sync(y.ravel()[0])
-    bw = 2 * x.nbytes / ((time.perf_counter() - t0) / 10)
+    def ew_scan(x):
+        def body(c, _):
+            return c * 1.0001 + 1.0, ()
+        out, _ = lax.scan(body, x, None, length=KB)
+        return out.ravel()[0]
+
+    _sync(ew_scan(x))  # compile
+
+    def timed_ew():
+        t0 = time.perf_counter()
+        _sync(ew_scan(x))
+        return time.perf_counter() - t0
+
+    bw = 2 * x.nbytes * KB / max(_best_of(3, timed_ew) - floor, 1e-9)
     return tf, bw
+
+
+def _step_cost(net, inputs, labels):
+    """XLA's flops + bytes-accessed for the compiled ComputationGraph train
+    step (the arithmetic behind roofline_util; see PERF.md)."""
+    step = net._jit_cache["std"]
+    comp = step.lower(net.params, net.opt_state, net.states, net._rng,
+                      inputs, labels, None, None, None).compile()
+    ca = comp.cost_analysis()
+    if isinstance(ca, list):
+        ca = ca[0]
+    return float(ca["flops"]), float(ca["bytes accessed"])
 
 
 def bench_resnet50(batch=256, image=224, steps=20, warmup=3,
@@ -120,22 +181,34 @@ def bench_resnet50(batch=256, image=224, steps=20, warmup=3,
         net.fit_batch(batches[i % n_buf])
     _sync(net._score_dev)
     floor_ms = _readback_floor_ms()
-    t0 = time.perf_counter()
-    for i in range(steps):
-        net.fit_batch(batches[i % n_buf])
-    _sync(net._score_dev)
-    total_ms = (time.perf_counter() - t0) * 1e3 - floor_ms
+    total_ms = _time_steps(lambda i: net.fit_batch(batches[i % n_buf]), steps,
+                           lambda: _sync(net._score_dev),
+                           trials=2) * 1e3 - floor_ms
     step_ms = max(total_ms, 1e-6) / steps
     sps = batch / (step_ms / 1e3)
-    return sps, step_ms, net
+    try:
+        flops, nbytes = _step_cost(
+            net, [batches[0].features], [batches[0].labels])
+    except Exception as e:
+        print(f"cost_analysis failed: {type(e).__name__}: {e}", file=sys.stderr)
+        flops = nbytes = None
+    return sps, step_ms, flops, nbytes
 
 
-def bench_resnet50_end_to_end(batch=256, image=224, n_batches=8,
-                              compute_dtype="bfloat16"):
+def bench_resnet50_end_to_end(compute_step_ms, batch=256, image=224,
+                              n_batches=8, compute_dtype="bfloat16"):
     """End-to-end fit(DataSetIterator): uint8 NHWC on the wire (4x fewer
     bytes), normalize on-chip (ImageScalerPreProcessor semantics via the
     integer-input cast), DevicePrefetchIterator overlapping h2d with compute.
-    Also reports the raw h2d link rate so the input-bound ceiling is visible."""
+
+    Reports per-batch link_ms (measured h2d of one uint8 batch) and
+    compute_ms next to the per-batch wall so the overlap claim is checkable:
+    wall should track max(link, compute), not their sum. `e2e_overlap` is the
+    fraction of the smaller leg hidden by the overlap
+    ((link + compute - wall) / min(link, compute); 1.0 = fully hidden,
+    <=0 = serial). The relay link rate is noisy (~3x), so the hard assertion
+    of the overlap property lives in tests/test_iterators.py on the CPU
+    backend; here the measured legs are reported for the record."""
     import jax
     import jax.numpy as jnp
     from deeplearning4j_tpu.zoo.models import resnet50
@@ -155,14 +228,17 @@ def bench_resnet50_end_to_end(batch=256, image=224, n_batches=8,
         y = np.eye(1000, dtype=np.float32)[rng.integers(0, 1000, batch)]
         sets.append(DataSet(x, y))
 
-    # raw h2d rate of one uint8 batch (what the link can do, measured)
+    # measured h2d link leg: one uint8 batch, best of 3 (noisy relay)
     xh = sets[0].features
     _sync(jnp.sum(jax.device_put(xh).astype(jnp.float32)))
-    t0 = time.perf_counter()
-    dev = jax.device_put(xh)
-    _sync(dev.ravel()[0])
-    h2d_s = time.perf_counter() - t0
-    h2d_mb_s = xh.nbytes / 1e6 / h2d_s
+    link_s = []
+    for _ in range(3):
+        t0 = time.perf_counter()
+        dev = jax.device_put(xh)
+        _sync(dev.ravel()[0])
+        link_s.append(time.perf_counter() - t0)
+    link_ms = min(link_s) * 1e3
+    h2d_mb_s = xh.nbytes / 1e6 / (link_ms / 1e3)
 
     net.fit_batch(sets[0])  # compile
     _sync(net._score_dev)
@@ -170,9 +246,11 @@ def bench_resnet50_end_to_end(batch=256, image=224, n_batches=8,
     it = DevicePrefetchIterator(ListDataSetIterator(sets), queue_size=2)
     net.fit(it)
     _sync(net._score_dev)
-    wall = time.perf_counter() - t0
-    e2e_sps = batch * n_batches / wall
-    return e2e_sps, h2d_mb_s
+    wall_ms = (time.perf_counter() - t0) * 1e3 / n_batches
+    e2e_sps = batch / (wall_ms / 1e3)
+    overlap = ((link_ms + compute_step_ms - wall_ms)
+               / max(min(link_ms, compute_step_ms), 1e-9))
+    return e2e_sps, h2d_mb_s, link_ms, wall_ms, overlap
 
 
 def bench_lenet(batch=128, steps=50, warmup=3):
@@ -191,13 +269,30 @@ def bench_lenet(batch=128, steps=50, warmup=3):
         net.fit_batch(ds)
     _sync(net._score_dev)
     floor_ms = _readback_floor_ms()
-    t0 = time.perf_counter()
-    for _ in range(steps):
-        net.fit_batch(ds)
-    _sync(net._score_dev)
-    total_ms = (time.perf_counter() - t0) * 1e3 - floor_ms
+    total_ms = _time_steps(lambda i: net.fit_batch(ds), steps,
+                           lambda: _sync(net._score_dev)) * 1e3 - floor_ms
     step_ms = max(total_ms, 1e-6) / steps
     return batch / (step_ms / 1e3), step_ms
+
+
+def bench_mnist_real_accuracy(epochs=6):
+    """BASELINE #1 on REAL digits (committed fixture, tests/fixtures/
+    mnist_real): full fit() run -> held-out accuracy. Returns None when only
+    the synthetic fallback is available (fixture deleted)."""
+    from deeplearning4j_tpu.datasets.fetchers.mnist import (
+        MnistDataSetIterator, load_mnist)
+    from deeplearning4j_tpu.zoo.models import lenet_mnist
+
+    from deeplearning4j_tpu.datasets.fetchers.mnist import _find_mnist_files
+    if _find_mnist_files(train=True)[0] is None:
+        return None  # synthetic fallback engaged; accuracy would be bogus
+    net = lenet_mnist()
+    net.init()
+    net.fit(MnistDataSetIterator(batch_size=64, train=True, seed=3),
+            epochs=epochs)
+    ev = net.evaluate(MnistDataSetIterator(batch_size=250, train=False,
+                                           shuffle=False))
+    return ev.accuracy()
 
 
 def bench_char_rnn(batch=64, seq=200, vocab=80, steps=10, warmup=2):
@@ -220,13 +315,77 @@ def bench_char_rnn(batch=64, seq=200, vocab=80, steps=10, warmup=2):
         net.fit_batch(ds)
     _sync(net._score_dev)
     floor_ms = _readback_floor_ms()
-    t0 = time.perf_counter()
-    for _ in range(steps):
-        net.fit_batch(ds)
-    _sync(net._score_dev)
-    total = (time.perf_counter() - t0) - floor_ms / 1e3
+    total = _time_steps(lambda i: net.fit_batch(ds), steps,
+                        lambda: _sync(net._score_dev)) - floor_ms / 1e3
     chars_per_sec = batch * seq * steps / max(total, 1e-9)
     return chars_per_sec
+
+
+def bench_transformer_lm(batch=16, seq=512, vocab=256, steps=10, warmup=2):
+    """Flagship-adjacent transformer LM: tokens/sec through the full
+    ComputationGraph train step (4 layers, d_model 256, 4 heads, causal,
+    Pallas flash attention, bf16 compute)."""
+    import jax.numpy as jnp
+    from deeplearning4j_tpu.zoo.models import transformer_lm
+    from deeplearning4j_tpu.datasets.dataset import DataSet
+
+    net = transformer_lm(vocab_size=vocab, d_model=256, n_layers=4, n_heads=4,
+                         use_pallas=True, compute_dtype="bfloat16")
+    net.init()
+    rng = np.random.default_rng(0)
+    ids = rng.integers(0, vocab, size=(batch, seq + 1))
+    x = np.eye(vocab, dtype=np.float32)[ids[:, :-1]]
+    y = np.eye(vocab, dtype=np.float32)[ids[:, 1:]]
+    ds = DataSet(jnp.asarray(x), jnp.asarray(y))
+    for _ in range(warmup):
+        net.fit_batch(ds)
+    _sync(net._score_dev)
+    floor_ms = _readback_floor_ms()
+    total = _time_steps(lambda i: net.fit_batch(ds), steps,
+                        lambda: _sync(net._score_dev)) - floor_ms / 1e3
+    return batch * seq * steps / max(total, 1e-9)
+
+
+def bench_flash_attention(B=4, H=8, T=2048, D=64, steps=10):
+    """Pallas flash-attention kernel vs the einsum reference, fwd+bwd on the
+    real chip (compiled, not interpret). Reports per-call ms for both paths
+    and the compiled temp memory of each (the [T,T] score materialization is
+    the reference's cost; flash holds only block tiles + the LSE residual)."""
+    import jax
+    import jax.numpy as jnp
+    from deeplearning4j_tpu.kernels.flash_attention import flash_attention
+    from deeplearning4j_tpu.parallel.ring_attention import attention_reference
+
+    rng = np.random.default_rng(0)
+    q = jnp.asarray(rng.normal(size=(B, T, H, D)).astype(np.float32),
+                    jnp.bfloat16)
+    k = jnp.asarray(rng.normal(size=(B, T, H, D)).astype(np.float32),
+                    jnp.bfloat16)
+    v = jnp.asarray(rng.normal(size=(B, T, H, D)).astype(np.float32),
+                    jnp.bfloat16)
+
+    def make(fn):
+        def loss(q, k, v):
+            return jnp.sum(fn(q, k, v, causal=True).astype(jnp.float32))
+        g = jax.jit(jax.grad(loss, argnums=(0, 1, 2)))
+        return g
+
+    out = {}
+    floor_ms = _readback_floor_ms()
+    for name, fn in (("flash", flash_attention),
+                     ("reference", attention_reference)):
+        g = make(fn)
+        dq, _, _ = g(q, k, v)
+        _sync(dq[0, 0, 0, 0])
+        t0 = time.perf_counter()
+        for _ in range(steps):
+            dq, dk, dv = g(q, k, v)
+        _sync(dq[0, 0, 0, 0])
+        out[name + "_ms"] = ((time.perf_counter() - t0) * 1e3 - floor_ms) / steps
+        comp = g.lower(q, k, v).compile()
+        out[name + "_temp_mb"] = comp.memory_analysis().temp_size_in_bytes / 1e6
+    out["speedup"] = out["reference_ms"] / out["flash_ms"]
+    return out
 
 
 def bench_word2vec(n_pairs=65536, dim=128, vocab=10000, steps=5, n_neg=5):
@@ -249,23 +408,33 @@ def bench_word2vec(n_pairs=65536, dim=128, vocab=10000, steps=5, n_neg=5):
     syn0, syn1 = skipgram_ns_step(syn0, syn1, unigram, centers, contexts,
                                   valid, 0.025, key, n_neg)  # compile
     _sync(syn0[0, 0])
-    t0 = time.perf_counter()
-    for i in range(steps):
-        key, sub = jax.random.split(key)
-        syn0, syn1 = skipgram_ns_step(syn0, syn1, unigram, centers, contexts,
-                                      valid, 0.025, sub, n_neg)
-    _sync(syn0[0, 0])
-    return n_pairs * steps / (time.perf_counter() - t0)
+    state = {"syn0": syn0, "syn1": syn1, "key": key}
+
+    def run_step(i):
+        state["key"], sub = jax.random.split(state["key"])
+        state["syn0"], state["syn1"] = skipgram_ns_step(
+            state["syn0"], state["syn1"], unigram, centers, contexts, valid,
+            0.025, sub, n_neg)
+
+    total = _time_steps(run_step, steps, lambda: _sync(state["syn0"][0, 0]))
+    return n_pairs * steps / total
 
 
 def bench_scaling_subprocess():
-    """BASELINE #4: multi-replica efficiency on the virtual 8-device CPU
-    mesh (ShardedTrainer = ParallelWrapper semantics, gradients all-reduced
-    in-step). Virtual devices share one CPU, so the metric is SPMD overhead
-    at fixed global batch: sharded-8-way vs unsharded throughput, ideal 1.0
-    (true scale-up needs real chips; the sharding compiles+executes here, and
-    the CPU emulation partly serializes per-device work, so the reported
-    value is a LOWER bound on real-mesh efficiency)."""
+    """BASELINE #4: SPMD overhead on the virtual 8-device CPU mesh
+    (ShardedTrainer = ParallelWrapper semantics, gradients all-reduced
+    in-step). The 8 virtual devices SHARE one physical CPU, so throughput
+    cannot scale here; what IS measurable is SPMD overhead, reported two
+    ways, both with ideal 1.0 on this rig:
+      - spmd_strong_ratio: fixed GLOBAL batch 512 — sharded-8-way wall vs
+        unsharded wall (same total work; partitioning/collective overhead
+        only).
+      - spmd_weak_ratio: fixed PER-DEVICE batch 512 — 8-way at global 4096
+        does 8x the work of 1-dev at 512 on the same CPU, so ideal wall is
+        8x and the ratio normalizes that away; real meshes would scale
+        throughput ~8x here.
+    Compile time is reported separately (spmd_compile_s) instead of being
+    smeared into throughput."""
     code = r"""
 import os, time, json
 os.environ["XLA_FLAGS"] = os.environ.get("XLA_FLAGS", "") + \
@@ -278,7 +447,7 @@ from deeplearning4j_tpu.zoo.models import mlp_mnist
 from deeplearning4j_tpu.datasets.dataset import DataSet
 from deeplearning4j_tpu.parallel.sharding import ShardedTrainer, make_mesh
 
-def run(n_dev, steps=20, batch=512):
+def run(n_dev, batch, steps=20):
     net = mlp_mnist(hidden=1024)
     net.init()
     mesh = make_mesh(n_data=n_dev, devices=jax.devices()[:n_dev])
@@ -287,22 +456,28 @@ def run(n_dev, steps=20, batch=512):
     x = rng.random((batch, 784)).astype(np.float32)
     y = np.eye(10, dtype=np.float32)[rng.integers(0, 10, batch)]
     ds = DataSet(x, y)
-    for _ in range(3):
+    t0 = time.perf_counter()
+    tr.fit_batch(ds)
+    compile_s = time.perf_counter() - t0
+    for _ in range(2):
         tr.fit_batch(ds)
     t0 = time.perf_counter()
     for _ in range(steps):
         tr.fit_batch(ds)
-    return batch * steps / (time.perf_counter() - t0)
+    return batch * steps / (time.perf_counter() - t0), compile_s
 
-one = run(1)
-eight = run(8)
-print(json.dumps({"sps_1dev": one, "sps_8dev": eight,
-                  "spmd_efficiency": eight / one}))
+sps_1, compile_1 = run(1, 512)
+sps_8s, compile_8 = run(8, 512)
+sps_8w, _ = run(8, 4096)
+print(json.dumps({
+    "sps_1dev": sps_1, "sps_8dev_strong": sps_8s, "sps_8dev_weak": sps_8w,
+    "strong_ratio": sps_8s / sps_1, "weak_ratio": sps_8w / sps_1,
+    "compile_s_1dev": compile_1, "compile_s_8dev": compile_8}))
 """
     env = dict(os.environ)
     env.pop("JAX_PLATFORMS", None)
     out = subprocess.run([sys.executable, "-c", code], capture_output=True,
-                         env=env, timeout=600, cwd=os.path.dirname(
+                         env=env, timeout=900, cwd=os.path.dirname(
                              os.path.abspath(__file__)))
     line = out.stdout.decode().strip().splitlines()[-1]
     return json.loads(line)
@@ -316,18 +491,28 @@ def main():
         extras["hbm_gbps_ceiling"] = round(bw_ceiling / 1e9, 1)
     except Exception as e:
         print(f"ceiling measurement failed: {e}", file=sys.stderr)
-        tf_ceiling = None
+        tf_ceiling = bw_ceiling = None
 
     headline_is_resnet = True
     try:
-        value, step_ms, _ = bench_resnet50()
+        value, step_ms, flops, nbytes = bench_resnet50()
         metric = "resnet50_train_samples_per_sec_per_chip"
         mfu = value * RESNET50_FLOPS_PER_SAMPLE / V5E_PEAK_FLOPS
         extras.update(step_ms=round(step_ms, 2), mfu=round(float(mfu), 4),
                       dtype="bfloat16", batch=256, image=224)
-        if tf_ceiling:
-            extras["roofline_util"] = round(
-                value * RESNET50_FLOPS_PER_SAMPLE / tf_ceiling, 4)
+        if flops is not None:
+            extras["xla_step_tflop"] = round(flops / 1e12, 2)
+            extras["xla_step_gb"] = round(nbytes / 1e9, 2)
+            extras["hbm_gbps_achieved"] = round(nbytes / (step_ms / 1e3) / 1e9, 1)
+            if tf_ceiling:
+                t_mm_ms = flops / tf_ceiling * 1e3
+                t_bw_ms = nbytes / bw_ceiling * 1e3
+                extras["roofline_compute_ms"] = round(t_mm_ms, 1)
+                extras["roofline_hbm_ms"] = round(t_bw_ms, 1)
+                extras["roofline_binding"] = ("hbm" if t_bw_ms > t_mm_ms
+                                              else "matmul")
+                extras["roofline_util"] = round(
+                    max(t_mm_ms, t_bw_ms) / step_ms, 3)
     except Exception as e:
         print(f"resnet50 bench failed ({type(e).__name__}: {e}); LeNet fallback",
               file=sys.stderr)
@@ -337,13 +522,16 @@ def main():
         extras["step_ms"] = round(step_ms, 2)
         extras["lenet_samples_per_sec"] = round(value, 1)
 
-    benches = [("char_rnn", lambda: bench_char_rnn()),
+    benches = [("mnist_real", lambda: bench_mnist_real_accuracy()),
+               ("char_rnn", lambda: bench_char_rnn()),
+               ("transformer", lambda: bench_transformer_lm()),
+               ("flash", lambda: bench_flash_attention()),
                ("word2vec", lambda: bench_word2vec()),
                ("scaling", lambda: bench_scaling_subprocess())]
     if headline_is_resnet:
         # e2e ratio only makes sense against a ResNet-50 compute headline,
         # and LeNet still needs its own number
-        benches = [("e2e", lambda: bench_resnet50_end_to_end()),
+        benches = [("e2e", lambda: bench_resnet50_end_to_end(step_ms)),
                    ("lenet", lambda: bench_lenet())] + benches
     for name, fn in benches:
         try:
@@ -351,15 +539,31 @@ def main():
             if name == "e2e":
                 extras["e2e_samples_per_sec"] = round(r[0], 1)
                 extras["h2d_mb_per_sec"] = round(r[1], 1)
+                extras["e2e_link_ms"] = round(r[2], 1)
+                extras["e2e_wall_ms_per_batch"] = round(r[3], 1)
+                extras["e2e_overlap"] = round(r[4], 2)
                 extras["e2e_vs_compute"] = round(r[0] / value, 3)
             elif name == "lenet":
                 extras["lenet_samples_per_sec"] = round(r[0], 1)
+            elif name == "mnist_real":
+                if r is not None:
+                    extras["mnist_real_test_acc"] = round(float(r), 4)
             elif name == "char_rnn":
                 extras["char_rnn_chars_per_sec"] = round(r, 1)
+            elif name == "transformer":
+                extras["transformer_lm_tokens_per_sec"] = round(r, 1)
+            elif name == "flash":
+                extras["flash_fwdbwd_ms"] = round(r["flash_ms"], 2)
+                extras["flash_ref_fwdbwd_ms"] = round(r["reference_ms"], 2)
+                extras["flash_speedup"] = round(r["speedup"], 2)
+                extras["flash_temp_mb"] = round(r["flash_temp_mb"], 1)
+                extras["flash_ref_temp_mb"] = round(r["reference_temp_mb"], 1)
             elif name == "word2vec":
                 extras["word2vec_pairs_per_sec"] = round(r, 1)
             else:
-                extras["spmd_efficiency_8dev"] = round(r["spmd_efficiency"], 2)
+                extras["spmd_strong_ratio"] = round(r["strong_ratio"], 2)
+                extras["spmd_weak_ratio"] = round(r["weak_ratio"], 2)
+                extras["spmd_compile_s_8dev"] = round(r["compile_s_8dev"], 1)
         except Exception as e:
             print(f"{name} bench failed: {type(e).__name__}: {e}",
                   file=sys.stderr)

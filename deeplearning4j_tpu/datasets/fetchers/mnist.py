@@ -6,10 +6,13 @@ in datasets/mnist/{MnistDbFile,MnistImageFile,MnistLabelFile,MnistManager}.java,
 iterator datasets/iterator/impl/MnistDataSetIterator.java.
 
 This environment has no egress, so the fetcher looks for local copies
-(MNIST_DIR env var, ~/.deeplearning4j_tpu/mnist, torchvision cache) and
-otherwise falls back to a deterministic synthetic digit set so tests and
-benchmarks run hermetically (generation is class-conditional so models can
-actually learn; clearly labeled synthetic).
+(MNIST_DIR env var, ~/.deeplearning4j_tpu/mnist, ...), then the committed
+REAL-digit fixture tests/fixtures/mnist_real (1297 train / 500 test genuine
+handwritten digits — UCI/NIST via sklearn's bundled load_digits, upsampled
+8x8->28x28 to the MNIST idx layout; tools/make_mnist_fixture.py documents
+provenance), and only as a last resort falls back to a deterministic
+synthetic digit set (clearly labeled synthetic; class-conditional so models
+can still learn).
 """
 from __future__ import annotations
 
@@ -60,6 +63,10 @@ def _find_mnist_files(train):
         os.path.expanduser("~/.cache/mnist"),
         "/root/data/mnist",
         "/data/mnist",
+        # committed real-digit fixture (see module docstring): full MNIST
+        # from any path above wins; real beats synthetic always
+        os.path.join(os.path.dirname(__file__), os.pardir, os.pardir,
+                     os.pardir, "tests", "fixtures", "mnist_real"),
     ]
     for d in candidates:
         if not d or not os.path.isdir(d):

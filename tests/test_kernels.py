@@ -98,13 +98,28 @@ def test_flash_fused_backward_bf16():
                                    np.asarray(b), rtol=1e-1, atol=1e-1)
 
 
-def test_flash_attention_fallback_on_ragged_seq():
-    # T=50 doesn't tile into 16-blocks -> silently uses the reference path
+def test_flash_attention_ragged_seq_shrinks_block():
+    # T=50 doesn't tile into 16-blocks: the block shrinks to the largest
+    # divisor (10) and the kernel still runs (tiny fp reassociation diffs vs
+    # the reference; the old behavior silently materialized [T,T] instead)
     rng = np.random.default_rng(7)
     q = jnp.asarray(rng.normal(size=(1, 50, 1, 8)).astype(np.float32))
     out = flash_attention(q, q, q, causal=True, block_q=16, block_k=16)
     ref = attention_reference(q, q, q, causal=True)
-    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), rtol=1e-5)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               rtol=1e-3, atol=1e-6)
+
+
+def test_flash_attention_fallback_on_narrow_head():
+    # D=6 violates the kernel's lane contract (D % 8) in every mode ->
+    # silently uses the reference path (only the default-scale rounding
+    # differs: f64 Python float here vs f32 jnp.sqrt inside the reference)
+    rng = np.random.default_rng(8)
+    q = jnp.asarray(rng.normal(size=(1, 32, 1, 6)).astype(np.float32))
+    out = flash_attention(q, q, q, causal=True)
+    ref = attention_reference(q, q, q, causal=True)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), rtol=1e-5,
+                               atol=1e-7)
 
 
 def test_flash_attention_bf16():

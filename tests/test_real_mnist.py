@@ -1,0 +1,55 @@
+"""BASELINE #1 on REAL data (VERDICT r3 #4): genuine handwritten digits
+through the untouched MnistDataSetIterator -> LeNet fit() -> Evaluation path.
+
+The committed fixture (tests/fixtures/mnist_real, built by
+tools/make_mnist_fixture.py) holds 1297 train / 500 test real pen-stroke
+digits in the MNIST idx.gz layout, so this exercises the same fetcher parsing
+(idx magic/header, gzip) the reference's MnistManager does
+(reference: datasets/mnist/MnistImageFile.java, MnistDataFetcher.java).
+"""
+import os
+
+import numpy as np
+import pytest
+
+import deeplearning4j_tpu.datasets.fetchers.mnist as mnist_mod
+from deeplearning4j_tpu.datasets.fetchers.mnist import (
+    MnistDataSetIterator, load_mnist)
+from deeplearning4j_tpu.zoo.models import lenet_mnist
+
+FIXTURE = os.path.join(os.path.dirname(__file__), "fixtures", "mnist_real")
+
+
+@pytest.fixture(autouse=True)
+def pin_fixture_dir(monkeypatch):
+    """Force the committed fixture even on machines that have a full local
+    MNIST copy in a higher-priority candidate dir (MNIST_DIR wins the search,
+    so pointing it at the fixture makes the test deterministic)."""
+    monkeypatch.setenv("MNIST_DIR", FIXTURE)
+    mnist_mod._CACHE.clear()
+    yield
+    mnist_mod._CACHE.clear()
+
+
+def test_fixture_is_real_not_synthetic():
+    imgs, labels = load_mnist(train=True)
+    # the synthetic fallback fabricates 60k; the committed real fixture is 1297
+    assert imgs.shape == (1297, 28, 28), (
+        "real-digit fixture not picked up — synthetic fallback engaged")
+    assert imgs.min() >= 0.0 and imgs.max() <= 1.0
+    # real digits: ink is sparse (the synthetic prototypes are dense uniform
+    # noise where <0.1-valued pixels are ~10%; bilinear upsampling smears
+    # strokes, so the real set sits near ~38% background here)
+    assert (imgs < 0.1).mean() > 0.3
+    assert sorted(np.unique(labels)) == list(range(10))
+
+
+def test_lenet_reaches_95pct_on_real_heldout():
+    train_it = MnistDataSetIterator(batch_size=64, train=True, seed=3)
+    test_it = MnistDataSetIterator(batch_size=250, train=False, shuffle=False)
+    net = lenet_mnist()
+    net.init()
+    net.fit(train_it, epochs=6)
+    ev = net.evaluate(test_it)
+    acc = ev.accuracy()
+    assert acc >= 0.95, f"held-out accuracy {acc:.3f} < 0.95 on real digits"

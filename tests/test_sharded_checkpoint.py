@@ -73,3 +73,91 @@ def test_sharded_checkpoint_of_tp_model_and_reshard_restore(tmp_path):
     # restored params are ALREADY mesh-sharded as requested
     w = net2.params["0"]["W"]
     assert w.sharding.spec == P(None, "model"), w.sharding
+
+
+def test_default_restore_rederives_saved_sharding(tmp_path):
+    """No `shardings` argument needed: the layout persisted at save time is
+    re-derived for the current topology, so orbax always receives concrete
+    shardings (no 'unsafe on a different topology' default path;
+    VERDICT r3 #8). Any orbax warning escalates to an error here."""
+    import warnings
+    from jax.sharding import PartitionSpec as P
+    net = _net(seed=5)
+    mesh = make_mesh(n_data=2, n_model=4)
+    rules = ShardingRules()
+    rules.add(r"^0/W$", P(None, "model"))
+    trainer = ShardedTrainer(net, mesh=mesh, rules=rules)
+    X, Y = _toy(n=32)
+    trainer.fit_batch(DataSet(X, Y))
+    flat_before = net.get_flat_params()
+    save_sharded(net, tmp_path / "ckpt")
+
+    with warnings.catch_warnings():
+        warnings.simplefilter("error")
+        net2 = restore_sharded(tmp_path / "ckpt")
+    np.testing.assert_allclose(net2.get_flat_params(), flat_before,
+                               rtol=0, atol=0)
+    w = net2.params["0"]["W"]
+    assert w.sharding.spec == P(None, "model"), w.sharding
+    got = dict(zip(w.sharding.mesh.axis_names, w.sharding.mesh.devices.shape))
+    assert got["data"] == 2 and got["model"] == 4, got
+
+
+def test_default_restore_onto_differently_shaped_mesh(tmp_path):
+    """Checkpoint written from a 4-device (2x2) mesh restores onto the
+    8-device test topology with no explicit shardings: the data axis is
+    rescaled (2x2 -> 4x2) and the persisted model-axis spec still applies."""
+    import warnings
+    from jax.sharding import PartitionSpec as P
+    net = _net(seed=9)
+    mesh = make_mesh(n_data=2, n_model=2, devices=jax.devices()[:4])
+    rules = ShardingRules()
+    rules.add(r"^0/W$", P(None, "model"))
+    trainer = ShardedTrainer(net, mesh=mesh, rules=rules)
+    X, Y = _toy(n=32)
+    trainer.fit_batch(DataSet(X, Y))
+    flat_before = net.get_flat_params()
+    save_sharded(net, tmp_path / "ckpt")
+
+    with warnings.catch_warnings():
+        warnings.simplefilter("error")
+        net2 = restore_sharded(tmp_path / "ckpt")
+    np.testing.assert_allclose(net2.get_flat_params(), flat_before,
+                               rtol=0, atol=0)
+    w = net2.params["0"]["W"]
+    assert w.sharding.spec == P(None, "model")
+    got = dict(zip(w.sharding.mesh.axis_names, w.sharding.mesh.devices.shape))
+    assert got["data"] == 4 and got["model"] == 2, got
+    # and training can continue on the re-derived layout
+    net2.fit(DataSet(X, Y))
+
+
+def test_default_restore_falls_back_when_rescaled_axis_stops_dividing(tmp_path):
+    """A 4-device checkpoint with a dim-6 leaf sharded over the data axis
+    cannot keep that spec when the axis rescales 2 -> 4 (6 % 4 != 0): the
+    default restore must degrade to a replicated layout, not crash."""
+    import warnings
+    from jax.sharding import PartitionSpec as P
+    conf = (NeuralNetConfiguration.builder().seed(2).updater(Adam(1e-2))
+            .list()
+            .layer(DenseLayer(n_out=6, activation="tanh"))
+            .layer(OutputLayer(n_out=3, activation="softmax", loss="MCXENT"))
+            .set_input_type(InputType.feed_forward(8))
+            .build())
+    net = MultiLayerNetwork(conf).init()
+    mesh = make_mesh(n_data=2, n_model=2, devices=jax.devices()[:4])
+    rules = ShardingRules()
+    rules.add(r"^0/b$", P("data"))  # dim 6 over data axis (2 divides, 4 won't)
+    trainer = ShardedTrainer(net, mesh=mesh, rules=rules)
+    X, Y = _toy(n=32)
+    trainer.fit_batch(DataSet(X, Y))
+    flat_before = net.get_flat_params()
+    save_sharded(net, tmp_path / "ckpt")
+
+    with warnings.catch_warnings():
+        warnings.simplefilter("error")
+        net2 = restore_sharded(tmp_path / "ckpt")  # 8 devices now
+    np.testing.assert_allclose(net2.get_flat_params(), flat_before,
+                               rtol=0, atol=0)
+    b = net2.params["0"]["b"]
+    assert b.sharding.spec == P(), b.sharding  # replicated fallback

@@ -1,0 +1,57 @@
+#!/usr/bin/env python
+"""Builds tests/fixtures/mnist_real: REAL handwritten digits in MNIST idx.gz
+format (VERDICT r3 #4 — kill the synthetic-only caveat on BASELINE #1).
+
+Source: sklearn.datasets.load_digits — the UCI ML Optical Recognition of
+Handwritten Digits set (1797 samples written by 43 people, collected on NIST
+preprocessing forms; public domain, bundled with sklearn so it exists in this
+zero-egress environment). These are REAL pen strokes, not the synthetic
+class-prototype fallback — but they are NOT LeCun's original MNIST images:
+the source resolution is 8x8 (0..16), bilinearly upsampled here to 28x28
+uint8 so the files are bit-compatible with the MNIST idx layout
+(reference: datasets/mnist/MnistImageFile.java header parsing) and flow
+through the untouched fetcher/iterator/LeNet path.
+
+Split: 1297 train / 500 test, stratified by a fixed shuffle (seed 7).
+Output ~260 KB gzipped. Deterministic: rerunning reproduces identical bytes
+(gzip mtime pinned to 0).
+"""
+import gzip
+import os
+import struct
+
+import numpy as np
+from scipy.ndimage import zoom
+from sklearn.datasets import load_digits
+
+OUT = os.path.join(os.path.dirname(__file__), os.pardir,
+                   "tests", "fixtures", "mnist_real")
+
+
+def write_idx(path, arr):
+    if arr.ndim == 3:
+        header = struct.pack(">IIII", 2051, *arr.shape)
+    else:
+        header = struct.pack(">II", 2049, arr.shape[0])
+    with open(path, "wb") as raw:
+        with gzip.GzipFile(fileobj=raw, mode="wb", mtime=0) as f:
+            f.write(header + arr.astype(np.uint8).tobytes())
+
+
+def main():
+    d = load_digits()
+    imgs = zoom(d.images / 16.0, (1, 3.5, 3.5), order=1)  # [1797, 28, 28]
+    imgs = np.clip(np.round(imgs * 255.0), 0, 255).astype(np.uint8)
+    labels = d.target.astype(np.uint8)
+    order = np.random.default_rng(7).permutation(len(imgs))
+    imgs, labels = imgs[order], labels[order]
+    os.makedirs(OUT, exist_ok=True)
+    write_idx(os.path.join(OUT, "train-images-idx3-ubyte.gz"), imgs[:1297])
+    write_idx(os.path.join(OUT, "train-labels-idx1-ubyte.gz"), labels[:1297])
+    write_idx(os.path.join(OUT, "t10k-images-idx3-ubyte.gz"), imgs[1297:])
+    write_idx(os.path.join(OUT, "t10k-labels-idx1-ubyte.gz"), labels[1297:])
+    print("wrote", OUT, "train=1297 test=500")
+
+
+if __name__ == "__main__":
+    main()
