@@ -469,10 +469,49 @@ def run(n_dev, batch, steps=20):
 sps_1, compile_1 = run(1, 512)
 sps_8s, compile_8 = run(8, 512)
 sps_8w, _ = run(8, 4096)
+
+# pipeline 1F1B: wall of the async-enqueued schedule vs the same compiled
+# stage executables host-fenced after every op (<1.0 = stages overlap).
+# Guarded so a pipeline failure cannot take the SPMD numbers down with it.
+pipe_ratio = None
+try:
+    from deeplearning4j_tpu import (NeuralNetConfiguration, InputType,
+                                    DenseLayer, OutputLayer,
+                                    MultiLayerNetwork, Sgd)
+    from deeplearning4j_tpu.parallel.pipeline import PipelineTrainer
+    b = NeuralNetConfiguration.builder().seed(11).updater(Sgd(0.05)).list()
+    for _ in range(8):
+        b = b.layer(DenseLayer(n_out=512, activation="tanh"))
+    conf = (b.layer(OutputLayer(n_out=8, activation="softmax", loss="MCXENT"))
+            .input_type(InputType.feed_forward(512)).build())
+    rng = np.random.default_rng(0)
+    Xp = rng.normal(size=(256, 512)).astype(np.float32)
+    Yp = np.eye(8, dtype=np.float32)[rng.integers(0, 8, 256)]
+    dsp = DataSet(Xp, Yp)
+    pt = PipelineTrainer(MultiLayerNetwork(conf).init(), n_stages=4,
+                         n_microbatches=8, devices=jax.devices()[:4])
+
+    def pipe_wall(fenced, reps=3):
+        pt._fence_every_op = fenced
+        best = float("inf")
+        for _ in range(reps):
+            t0 = time.perf_counter()
+            pt.fit_batch(dsp)
+            jax.block_until_ready(pt.model.params)
+            best = min(best, time.perf_counter() - t0)
+        return best
+
+    pipe_wall(False); pipe_wall(True)
+    pipe_ratio = pipe_wall(False) / pipe_wall(True)
+except Exception as e:
+    import sys as _sys
+    print(f"pipeline overlap bench failed: {e}", file=_sys.stderr)
+
 print(json.dumps({
     "sps_1dev": sps_1, "sps_8dev_strong": sps_8s, "sps_8dev_weak": sps_8w,
     "strong_ratio": sps_8s / sps_1, "weak_ratio": sps_8w / sps_1,
-    "compile_s_1dev": compile_1, "compile_s_8dev": compile_8}))
+    "compile_s_1dev": compile_1, "compile_s_8dev": compile_8,
+    "pipeline_overlap_ratio": pipe_ratio}))
 """
     env = dict(os.environ)
     env.pop("JAX_PLATFORMS", None)
@@ -564,6 +603,9 @@ def main():
                 extras["spmd_strong_ratio"] = round(r["strong_ratio"], 2)
                 extras["spmd_weak_ratio"] = round(r["weak_ratio"], 2)
                 extras["spmd_compile_s_8dev"] = round(r["compile_s_8dev"], 1)
+                if r.get("pipeline_overlap_ratio") is not None:
+                    extras["pipeline_overlap_ratio"] = round(
+                        r["pipeline_overlap_ratio"], 2)
         except Exception as e:
             print(f"{name} bench failed: {type(e).__name__}: {e}",
                   file=sys.stderr)

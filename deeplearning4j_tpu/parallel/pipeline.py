@@ -1,23 +1,35 @@
-"""Pipeline parallelism: GPipe-style stage partitioning with microbatching.
+"""Pipeline parallelism: compiled per-stage executables on a 1F1B schedule.
 
 NEW capability with no reference counterpart (SURVEY.md §2.4 "Absent": no
 pipeline parallelism upstream). A MultiLayerNetwork's layer stack is split
 into S contiguous stages, each stage's parameters live on their own device,
-and every global batch is fed as M microbatches: stage s runs microbatch m
-while stage s+1 runs microbatch m-1 (the classic GPipe schedule — here the
-overlap comes from JAX's async dispatch: each stage's jitted microbatch step
-is enqueued on its own device queue and the host never blocks between
-enqueues). Backward replays the saved per-stage VJPs in reverse, gradients
-accumulate across microbatches, and the model's own per-layer optax
-transforms apply the update stage-locally.
+and every global batch is fed as M microbatches.
+
+Execution model: every unit of stage work is ONE jitted XLA executable —
+forward `fwd(pslice, x, rng) -> act`, backward
+`bwd(pslice, x, rng, cot) -> (grads, dx)` (activation-recompute: the
+backward replays the stage forward inside the same executable, so residuals
+never cross the jit boundary and per-microbatch live state is just the stage
+INPUT + one cotangent), a fused last-stage `loss_and_grads`, and a donated
+per-stage optimizer update. The host only ENQUEUES these executables — in
+the interleaved one-forward-one-backward (1F1B / PipeDream-flush) order —
+and never blocks: JAX async dispatch keeps every stage device's queue busy
+while later microbatches stream in, which is what bounds in-flight
+microbatches to ~S instead of GPipe's M and lets stage s run microbatch m's
+forward while stage s+1 runs m-1's backward. The overlap is a tested
+property (tests/test_parallel.py: pipelined wall vs the same executables
+host-fenced).
 
 Equivalence contract (tested): with mean losses and equal microbatches,
 pipeline training over S stages x M microbatches produces the SAME parameter
 update as single-device full-batch training.
+
+Stateful layers (BatchNormalization running stats) are REJECTED by default:
+stage executables treat layer state as frozen, so training such a model
+would silently diverge from fit()'s semantics. Pass allow_stale_state=True
+to accept frozen statistics knowingly, or train with ShardedTrainer.
 """
 from __future__ import annotations
-
-import warnings
 
 import numpy as np
 import jax
@@ -29,7 +41,7 @@ from ..nn.updaters import apply_gradient_normalization
 
 class PipelineTrainer:
     def __init__(self, model, n_stages=2, n_microbatches=4, devices=None,
-                 boundaries=None):
+                 boundaries=None, allow_stale_state=False):
         """boundaries: optional explicit stage split points (layer indices);
         default splits layers evenly. devices: one per stage (defaults to the
         first n_stages of jax.devices())."""
@@ -57,8 +69,16 @@ class PipelineTrainer:
         if len(self.devices) < self.n_stages:
             raise ValueError(f"need {self.n_stages} devices, have "
                              f"{len(self.devices)}")
+        if (not allow_stale_state and any(
+                jax.tree_util.tree_leaves(v) for v in model.states.values())):
+            raise ValueError(
+                "PipelineTrainer compiles per-stage steps with layer state "
+                "frozen (BatchNormalization running statistics would go "
+                "stale); train stateful models with fit()/ShardedTrainer, "
+                "or pass allow_stale_state=True to accept frozen stats")
         self._place_stages()
-        self._fwd_jits = {}
+        self._jits = {}
+        self._fence_every_op = False  # test hook: defeat async overlap
 
     # ------------------------------------------------------------ placement
     def _stage_layers(self, s):
@@ -72,70 +92,138 @@ class PipelineTrainer:
                 k = str(i)
                 m.params[k] = jax.device_put(m.params[k], dev)
                 m.states[k] = jax.device_put(m.states[k], dev)
-        # opt state stays where optax puts it; updates run stage-locally
-        if any(jax.tree_util.tree_leaves(v) for v in m.states.values()):
-            warnings.warn(
-                "PipelineTrainer does not update per-layer state "
-                "(BatchNormalization running statistics stay at their "
-                "current values); train stateful layers with fit()/"
-                "ShardedTrainer instead", stacklevel=3)
+                m.opt_state[k] = jax.device_put(m.opt_state[k], dev)
 
-    # ------------------------------------------------------------- forward
-    def _stage_forward(self, s):
-        """Jitted pure forward for stage s: (params_slice, x) -> (out, states).
-        The LAST stage returns the mean loss instead (labels threaded in)."""
+    # --------------------------------------------------- stage executables
+    def _run_layers(self, pslice, feats, rng, layer_idxs):
         m = self.model
-        last = s == self.n_stages - 1
-        idxs = list(self._stage_layers(s))
+        for i in layer_idxs:
+            pre = m.conf.input_preprocessors.get(i)
+            if rng is not None:
+                rng, pre_rng, sub = jax.random.split(rng, 3)
+            else:
+                pre_rng = sub = None
+            if pre is not None:
+                feats = pre(feats, None, rng=pre_rng)
+            feats, _, _ = m.layers[i].forward(
+                pslice[str(i)], m.states[str(i)], feats,
+                train=True, rng=sub)[:3]
+        return feats
 
+    def _mid_forward_fn(self, s):
+        """Pure forward of a non-final stage (mixed precision mirrors the
+        single-device step: hidden layers run in the compute dtype)."""
+        m = self.model
+        idxs = list(self._stage_layers(s))
         cd = m._compute_dtype()
 
-        def _run(pslice, feats, rng, layer_idxs):
-            for i in layer_idxs:
-                pre = m.conf.input_preprocessors.get(i)
-                if rng is not None:
-                    rng, pre_rng, sub = jax.random.split(rng, 3)
-                else:
-                    pre_rng = sub = None
-                if pre is not None:
-                    feats = pre(feats, None, rng=pre_rng)
-                feats, _, _ = m.layers[i].forward(
-                    pslice[str(i)], m.states[str(i)], feats,
-                    train=True, rng=sub)[:3]
-            return feats
+        def fn(pslice, x, rng):
+            if cd is not None:
+                pslice = m._cast_floats(pslice, cd)
+                x = x.astype(cd) if jnp.issubdtype(x.dtype, jnp.floating) \
+                    else x
+            return self._run_layers(pslice, x, rng, idxs)
+        return fn
 
-        if s not in self._fwd_jits:
-            if last:
-                def fn(pslice, x, y, rng):
-                    # mixed precision mirrors the single-device step: hidden
-                    # layers in the compute dtype, output layer + loss in f32
-                    out_i = idxs[-1]
-                    if cd is not None:
-                        pslice = {k: (v if k == str(out_i)
-                                      else m._cast_floats(v, cd))
-                                  for k, v in pslice.items()}
-                        x = x.astype(cd) if jnp.issubdtype(
-                            x.dtype, jnp.floating) else x
-                    feats = _run(pslice, x, rng, idxs[:-1])
-                    feats2, _ = m._apply_preprocessor(out_i, feats, None)
-                    if cd is not None:
-                        feats2 = feats2.astype(m._dtype)
-                    return m.layers[out_i].score(pslice[str(out_i)], feats2,
-                                                 y, None, True, None)
-            else:
-                def fn(pslice, x, rng):
-                    if cd is not None:
-                        pslice = m._cast_floats(pslice, cd)
-                        x = x.astype(cd) if jnp.issubdtype(
-                            x.dtype, jnp.floating) else x
-                    return _run(pslice, x, rng, idxs)
-            self._fwd_jits[s] = jax.jit(fn)  # placement follows the inputs
-        return self._fwd_jits[s]
+    def _last_forward_fn(self, s):
+        """Mean loss of the final stage (output layer + loss in f32)."""
+        m = self.model
+        idxs = list(self._stage_layers(s))
+        cd = m._compute_dtype()
+
+        def fn(pslice, x, y, rng):
+            out_i = idxs[-1]
+            if cd is not None:
+                pslice = {k: (v if k == str(out_i) else m._cast_floats(v, cd))
+                          for k, v in pslice.items()}
+                x = x.astype(cd) if jnp.issubdtype(x.dtype, jnp.floating) \
+                    else x
+            feats = self._run_layers(pslice, x, rng, idxs[:-1])
+            feats2, _ = m._apply_preprocessor(out_i, feats, None)
+            if cd is not None:
+                feats2 = feats2.astype(m._dtype)
+            return m.layers[out_i].score(pslice[str(out_i)], feats2, y, None,
+                                         True, None)
+        return fn
+
+    def _fwd(self, s):
+        """Jitted forward executable for a non-final stage."""
+        key = ("fwd", s)
+        if key not in self._jits:
+            self._jits[key] = jax.jit(self._mid_forward_fn(s))
+        return self._jits[key]
+
+    def _bwd(self, s):
+        """Jitted backward executable for a non-final stage: recomputes the
+        stage forward from its input (same rng => identical activations) and
+        pulls the cotangent through — (param grads, input cotangent)."""
+        key = ("bwd", s)
+        if key not in self._jits:
+            fwd = self._mid_forward_fn(s)
+
+            def fn(pslice, x, rng, cot):
+                _, vjp = jax.vjp(lambda p, a: fwd(p, a, rng), pslice, x)
+                gp, gx = vjp(cot)
+                return gp, gx
+            self._jits[key] = jax.jit(fn)
+        return self._jits[key]
+
+    def _last(self, s):
+        """Fused loss+backward of the final stage (its 1F1B forward and
+        backward slots are adjacent, so one executable does both)."""
+        key = ("last", s)
+        if key not in self._jits:
+            lfn = self._last_forward_fn(s)
+
+            def fn(pslice, x, y, rng):
+                loss, vjp = jax.vjp(lambda p, a: lfn(p, a, y, rng), pslice, x)
+                gp, gx = vjp(jnp.ones((), loss.dtype))
+                return loss, gp, gx
+            self._jits[key] = jax.jit(fn)
+        return self._jits[key]
+
+    def _update(self, s):
+        """Jitted, donated per-stage optimizer update: microbatch-sum grads
+        -> /M average -> per-layer grad-norm + optax transform -> new params
+        and opt state, all on the stage's device."""
+        key = ("upd", s)
+        if key not in self._jits:
+            m = self.model
+            idxs = [str(i) for i in self._stage_layers(s)]
+            confs = {str(i): m.conf.layers[i] for i in self._stage_layers(s)}
+            M = self.n_microbatches
+
+            def fn(pslice, oslice, gsum):
+                new_p, new_o = {}, {}
+                for k in idxs:
+                    g = jax.tree_util.tree_map(lambda a: a / M, gsum[k])
+                    lc = confs[k]
+                    if lc.gradient_normalization and g:
+                        g = apply_gradient_normalization(
+                            g, lc.gradient_normalization,
+                            lc.gradient_normalization_threshold or 1.0)
+                    upd, no = m._tx.update({k: g}, {k: oslice[k]},
+                                           {k: pslice[k]})
+                    new_p[k] = optax.apply_updates(pslice[k], upd[k])
+                    new_o[k] = no[k]
+                return new_p, new_o
+            # gsum has no same-shaped output to alias (new_p/new_o reuse the
+            # param and opt buffers), so donating it only triggers the
+            # "donated buffers were not usable" warning
+            self._jits[key] = jax.jit(fn, donate_argnums=(0, 1))
+        return self._jits[key]
+
+    def _maybe_fence(self, x):
+        if self._fence_every_op:
+            jax.block_until_ready(x)
+        return x
 
     # -------------------------------------------------------------- train
     def fit_batch(self, ds):
-        """One pipelined step: microbatch forward wavefront, reverse VJP
-        backward, accumulated grads, per-layer update applied in place."""
+        """One pipelined step. The host enqueues compiled stage executables
+        in the interleaved 1F1B order — forward diagonal t immediately
+        followed by backward diagonal t-(S-1) — then the donated per-stage
+        updates; nothing blocks until the caller reads the score."""
         m = self.model
         x_np = np.asarray(ds.features)
         y_np = np.asarray(ds.labels)
@@ -145,71 +233,83 @@ class PipelineTrainer:
             raise ValueError(f"batch {B} must divide into {M} microbatches")
         xs = np.split(x_np, M)
         ys = np.split(y_np, M)
-
         S = self.n_stages
         pslices = [{str(i): m.params[str(i)] for i in self._stage_layers(s)}
                    for s in range(S)]
-
-        # forward wavefront: for each microbatch, run stages in order,
-        # device_put-ing activations across stage boundaries; vjps saved
         m._rng, step_rng = jax.random.split(m._rng)
-        mb_rngs = jax.random.split(step_rng, M * S).reshape(M, S, -1)
-        vjps = [[None] * S for _ in range(M)]
-        losses = []
-        for mb in range(M):
-            act = jax.device_put(jnp.asarray(xs[mb]), self.devices[0])
-            for s in range(S - 1):
-                r = jax.device_put(mb_rngs[mb, s], self.devices[s])
-                out, vjp = jax.vjp(
-                    lambda p, a, s=s, r=r: self._stage_forward(s)(p, a, r),
-                    pslices[s], act)
-                vjps[mb][s] = vjp
-                act = jax.device_put(out, self.devices[s + 1])
-            y_dev = jax.device_put(jnp.asarray(ys[mb]), self.devices[S - 1])
-            r = jax.device_put(mb_rngs[mb, S - 1], self.devices[S - 1])
-            loss, vjp = jax.vjp(
-                lambda p, a, r=r: self._stage_forward(S - 1)(p, a, y_dev, r),
-                pslices[S - 1], act)
-            vjps[mb][S - 1] = vjp
-            losses.append(loss)
+        mb_rngs = np.asarray(jax.random.split(step_rng, M * S)).reshape(
+            M, S, -1)
 
-        # backward: reverse stages per microbatch; grads accumulate
+        stage_in = {}           # (m, s) -> stage input, freed after backward
+        cot = [None] * M        # inbound cotangent per microbatch
         grad_acc = [None] * S
-        for mb in range(M):
-            cot = jnp.ones((), losses[mb].dtype)
-            for s in reversed(range(S)):
-                gp, gx = vjps[mb][s](cot)
-                grad_acc[s] = gp if grad_acc[s] is None else \
-                    jax.tree_util.tree_map(jnp.add, grad_acc[s], gp)
-                if s > 0:
-                    cot = jax.device_put(gx, self.devices[s - 1])
+        losses = []
 
-        # per-layer update on each stage's device (grads averaged over M —
-        # each microbatch loss is a mean, so sum/M == full-batch gradient)
+        def acc(s, gp):
+            grad_acc[s] = gp if grad_acc[s] is None else \
+                jax.tree_util.tree_map(jnp.add, grad_acc[s], gp)
+
+        def run_f(mb, s):
+            if s == 0:
+                stage_in[(mb, 0)] = jax.device_put(jnp.asarray(xs[mb]),
+                                                   self.devices[0])
+            x = stage_in[(mb, s)]
+            r = jax.device_put(mb_rngs[mb, s], self.devices[s])
+            if s == S - 1:
+                y = jax.device_put(jnp.asarray(ys[mb]), self.devices[s])
+                loss, gp, gx = self._last(s)(pslices[s], x, y, r)
+                losses.append(loss)
+                acc(s, gp)
+                if S > 1:
+                    cot[mb] = jax.device_put(gx, self.devices[s - 1])
+                del stage_in[(mb, s)]
+                self._maybe_fence(loss)
+            else:
+                out = self._fwd(s)(pslices[s], x, r)
+                stage_in[(mb, s + 1)] = jax.device_put(out,
+                                                       self.devices[s + 1])
+                self._maybe_fence(out)
+
+        def run_b(mb, s):
+            if s == S - 1:
+                return  # fused into run_f
+            x = stage_in.pop((mb, s))
+            r = jax.device_put(mb_rngs[mb, s], self.devices[s])
+            gp, gx = self._bwd(s)(pslices[s], x, r, cot[mb])
+            acc(s, gp)
+            cot[mb] = jax.device_put(gx, self.devices[s - 1]) if s > 0 \
+                else None
+            self._maybe_fence(gp)
+
+        def bwd_diagonal(u):
+            for s in reversed(range(S)):
+                mb = u - (S - 1 - s)
+                if 0 <= mb < M:
+                    run_b(mb, s)
+
+        # interleaved 1F1B: forward diagonal t, then the backward diagonal
+        # whose last-stage microbatch just finished (u = t - (S-1))
+        for t in range(M + S - 1):
+            for s in range(S):
+                mb = t - s
+                if 0 <= mb < M:
+                    run_f(mb, s)
+            if t - (S - 1) >= 0:
+                bwd_diagonal(t - (S - 1))
+        for u in range(M, M + S - 1):
+            bwd_diagonal(u)
+
+        # per-stage donated updates (enqueued on each stage's own device)
         for s in range(S):
-            for i in self._stage_layers(s):
-                k = str(i)
-                g = jax.tree_util.tree_map(lambda a: a / M, grad_acc[s][k])
-                lc = m.conf.layers[i]
-                if lc.gradient_normalization and g:
-                    g = apply_gradient_normalization(
-                        g, lc.gradient_normalization,
-                        lc.gradient_normalization_threshold or 1.0)
-                # apply just this layer's sub-transform
-                upd, new_state = m._tx.update({k: g}, {k: _opt_slice(m, k)},
-                                              {k: m.params[k]})
-                m.params[k] = optax.apply_updates(m.params[k], upd[k])
-                _set_opt_slice(m, k, new_state[k])
-        m.score_value = float(np.mean([float(l) for l in losses]))
+            oslice = {str(i): m.opt_state[str(i)]
+                      for i in self._stage_layers(s)}
+            new_p, new_o = self._update(s)(pslices[s], oslice, grad_acc[s])
+            for k, v in new_p.items():
+                m.params[k] = v
+            for k, v in new_o.items():
+                m.opt_state[k] = v
+        m.score_value = jnp.mean(jnp.stack(losses))  # device scalar
         m.iteration_count += 1
         for listener in m.listeners:
             listener.iteration_done(m, m.iteration_count)
-        return m.score_value
-
-
-def _opt_slice(m, k):
-    return m.opt_state[k]
-
-
-def _set_opt_slice(m, k, v):
-    m.opt_state[k] = v
+        return float(m.score_value)

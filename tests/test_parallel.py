@@ -295,3 +295,80 @@ def test_pipeline_three_stages_four_layers_no_empty_stage():
     assert np.isfinite(pt.fit_batch(DataSet(X, Y)))
     with pytest.raises(ValueError, match="stages > "):
         PipelineTrainer(MultiLayerNetwork(conf).init(), n_stages=5)
+
+
+def test_pipeline_rejects_stateful_layers_by_default():
+    """BatchNormalization running stats would silently freeze inside the
+    compiled stage executables -> hard error unless explicitly accepted."""
+    from deeplearning4j_tpu.parallel.pipeline import PipelineTrainer
+    from deeplearning4j_tpu.nn.conf.layers import BatchNormalization
+    conf = (NeuralNetConfiguration.builder().seed(4).updater(Sgd(0.1)).list()
+            .layer(DenseLayer(n_out=8, activation="relu"))
+            .layer(BatchNormalization())
+            .layer(OutputLayer(n_out=3, activation="softmax", loss="MCXENT"))
+            .input_type(InputType.feed_forward(8))
+            .build())
+    with pytest.raises(ValueError, match="stale"):
+        PipelineTrainer(MultiLayerNetwork(conf).init(), n_stages=2)
+    # explicit opt-in constructs (stats knowingly frozen)
+    pt = PipelineTrainer(MultiLayerNetwork(conf).init(), n_stages=2,
+                         allow_stale_state=True)
+    X, Y = _toy(n=8)
+    assert np.isfinite(pt.fit_batch(DataSet(X, Y)))
+
+
+def test_pipeline_async_schedule_overlaps_stages():
+    """The 1F1B schedule's value is that the host only ENQUEUES compiled
+    stage executables and async dispatch overlaps them across stage devices.
+    Measured form: the pipelined step must be faster than the IDENTICAL
+    executables with a host fence after every enqueue (which reduces the
+    schedule to serialized stage-at-a-time execution). On real multi-chip
+    hardware this same property is what turns into linear pipeline speedup;
+    the virtual-device CPU mesh still shows it because XLA executables from
+    different devices interleave."""
+    import time
+    from deeplearning4j_tpu.parallel.pipeline import PipelineTrainer
+
+    def build():
+        b = NeuralNetConfiguration.builder().seed(11).updater(Sgd(0.05)).list()
+        for _ in range(8):
+            b = b.layer(DenseLayer(n_out=512, activation="tanh"))
+        conf = (b.layer(OutputLayer(n_out=8, activation="softmax",
+                                    loss="MCXENT"))
+                .input_type(InputType.feed_forward(512))
+                .build())
+        return MultiLayerNetwork(conf).init()
+
+    rng = np.random.default_rng(0)
+    X = rng.normal(size=(256, 512)).astype(np.float32)
+    Y = np.eye(8, dtype=np.float32)[rng.integers(0, 8, 256)]
+    ds = DataSet(X, Y)
+    pt = PipelineTrainer(build(), n_stages=4, n_microbatches=8,
+                         devices=jax.devices()[:4])
+
+    def wall(fenced, reps=3):
+        pt._fence_every_op = fenced
+        best = float("inf")
+        for _ in range(reps):
+            t0 = time.perf_counter()
+            pt.fit_batch(ds)
+            jax.block_until_ready(pt.model.params)
+            best = min(best, time.perf_counter() - t0)
+        return best
+
+    wall(False)  # compile both paths
+    wall(True)
+    # one shared physical core bounds the measurable gain (observed ~0.83
+    # fenced-relative); a loaded CI core can jitter past that, so the
+    # property gets three chances before the test calls it a failure
+    ratios = []
+    for _ in range(3):
+        overlapped = wall(False)
+        fenced = wall(True)
+        ratios.append(overlapped / fenced)
+        if ratios[-1] < 0.95:
+            break
+    pt._fence_every_op = False
+    assert min(ratios) < 0.95, (
+        f"pipelined/fenced wall ratios {ratios} never under 0.95 — stage "
+        f"execution is not overlapping")
