@@ -7,15 +7,20 @@ kafka/NDArrayKafkaClient.java (NDArray publish/consume),
 serde/RecordSerializer.java (wire format).
 
 TPU-first redesign: the Camel/Kafka machinery collapses to a pluggable
-Source/Sink SPI around a jit-compiled `model.output` hot path — the broker
-integration is host-side IO and framework-agnostic, so the in-repo
-implementations are an HTTP server (`InferenceServer`) and in-memory
-queues (`QueueSource`/`QueueSink`) with the same route semantics.
+Source/Sink SPI around a jit-compiled `model.output` hot path. In-repo
+endpoints: a real TCP pub/sub broker + reconnecting client
+(`MessageBroker`/`BrokerClient` with `BrokerSource`/`BrokerSink` adapters,
+the NDArrayKafkaClient analog), an HTTP server (`InferenceServer`), and
+in-memory queues (`QueueSource`/`QueueSink`) for tests. The reference's
+Spark streaming pipeline (pipeline/kafka/BaseKafkaPipeline.java) is
+subsumed by BrokerSource -> ServeRoute -> BrokerSink composition.
 """
 from .serde import NDArrayMessage, serialize_array, deserialize_array
 from .routes import StreamSource, StreamSink, QueueSource, QueueSink, ServeRoute
 from .serve import InferenceServer
+from .broker import (MessageBroker, BrokerClient, BrokerSource, BrokerSink)
 
 __all__ = ["NDArrayMessage", "serialize_array", "deserialize_array",
            "StreamSource", "StreamSink", "QueueSource", "QueueSink",
-           "ServeRoute", "InferenceServer"]
+           "ServeRoute", "InferenceServer", "MessageBroker", "BrokerClient",
+           "BrokerSource", "BrokerSink"]

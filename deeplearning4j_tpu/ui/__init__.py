@@ -5,7 +5,7 @@ JSON chart/table components). See SURVEY.md §2.8.
 from .stats import StatsListener, StatsReport, StatsInitReport, ProfilerListener
 from .storage import (StatsStorageRouter, CollectionStatsStorageRouter,
                       InMemoryStatsStorage, FileStatsStorage,
-                      RemoteUIStatsStorageRouter)
+                      SqliteStatsStorage, RemoteUIStatsStorageRouter)
 from .server import (UIServer, UIModule, TrainModule, DefaultModule,
                      RemoteReceiverModule)
 from . import components
@@ -13,7 +13,8 @@ from . import components
 __all__ = [
     "StatsListener", "StatsReport", "StatsInitReport", "ProfilerListener",
     "StatsStorageRouter", "CollectionStatsStorageRouter",
-    "InMemoryStatsStorage", "FileStatsStorage", "RemoteUIStatsStorageRouter",
+    "InMemoryStatsStorage", "FileStatsStorage", "SqliteStatsStorage",
+    "RemoteUIStatsStorageRouter",
     "UIServer", "UIModule", "TrainModule", "DefaultModule",
     "RemoteReceiverModule", "components",
 ]

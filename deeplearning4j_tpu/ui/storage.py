@@ -6,11 +6,14 @@ StatsStorageRouter.java, Persistable.java} and impl/
 deeplearning4j-ui-model storage/{InMemoryStatsStorage, FileStatsStorage,
 mapdb/MapDBStatsStorage, sqlite/J7FileStatsStorage}.
 
-The reports are JSON (ui/stats.py) so FileStatsStorage is a JSONL append log
-(replacing MapDB/SQLite — same durability role, zero dependencies).
+The reports are JSON (ui/stats.py). Two durable tiers, mirroring the
+reference: FileStatsStorage is a JSONL append log (FileStatsStorage.java
+role), SqliteStatsStorage is the indexed store with a concurrent-reader
+story (J7FileStatsStorage/MapDBStatsStorage role; stdlib sqlite3, WAL).
 """
 from __future__ import annotations
 
+import contextlib
 import json
 import os
 import threading
@@ -41,7 +44,24 @@ class CollectionStatsStorageRouter(StatsStorageRouter):
         self.updates.append(report)
 
 
-class InMemoryStatsStorage(StatsStorageRouter):
+def _as_dict(report):
+    """Unwrap a StatsReport (or accept a plain mapping)."""
+    return report.data if hasattr(report, "data") else dict(report)
+
+
+class _ListenerHub:
+    """Subscription side shared by the read+write storages (StatsStorage
+    listener semantics the UI server attaches to)."""
+
+    def register_listener(self, fn):
+        self._listeners.append(fn)
+
+    def _notify(self, d):
+        for fn in self._listeners:
+            fn(d)
+
+
+class InMemoryStatsStorage(StatsStorageRouter, _ListenerHub):
     """Read+write storage (reference: InMemoryStatsStorage.java). Also the
     subscription hub the UI server attaches to (StatsStorage listeners)."""
 
@@ -53,13 +73,13 @@ class InMemoryStatsStorage(StatsStorageRouter):
 
     # ---- router (write) ---------------------------------------------------
     def put_static_info(self, report):
-        d = report.data if hasattr(report, "data") else dict(report)
+        d = _as_dict(report)
         with self._lock:
             self._static[d["session_id"]] = d
         self._notify(d)
 
     def put_update(self, report):
-        d = report.data if hasattr(report, "data") else dict(report)
+        d = _as_dict(report)
         with self._lock:
             self._updates.setdefault(d["session_id"], []).append(d)
         self._notify(d)
@@ -83,14 +103,6 @@ class InMemoryStatsStorage(StatsStorageRouter):
             ups = self._updates.get(session_id)
             return ups[-1] if ups else None
 
-    # ---- listeners --------------------------------------------------------
-    def register_listener(self, fn):
-        self._listeners.append(fn)
-
-    def _notify(self, d):
-        for fn in self._listeners:
-            fn(d)
-
 
 class FileStatsStorage(InMemoryStatsStorage):
     """Durable JSONL-backed storage (reference: FileStatsStorage.java /
@@ -113,13 +125,13 @@ class FileStatsStorage(InMemoryStatsStorage):
         self._fh = open(self.path, "a")
 
     def put_static_info(self, report):
-        d = report.data if hasattr(report, "data") else dict(report)
+        d = _as_dict(report)
         self._fh.write(json.dumps(d) + "\n")
         self._fh.flush()
         super().put_static_info(d)
 
     def put_update(self, report):
-        d = report.data if hasattr(report, "data") else dict(report)
+        d = _as_dict(report)
         self._fh.write(json.dumps(d) + "\n")
         self._fh.flush()
         super().put_update(d)
@@ -157,7 +169,113 @@ class RemoteUIStatsStorageRouter(StatsStorageRouter):
                 time.sleep(self.backoff_base_ms / 1000.0 * (2 ** attempt))
 
     def put_static_info(self, report):
-        self._post(report.data if hasattr(report, "data") else dict(report))
+        self._post(_as_dict(report))
 
     def put_update(self, report):
-        self._post(report.data if hasattr(report, "data") else dict(report))
+        self._post(_as_dict(report))
+
+
+class SqliteStatsStorage(StatsStorageRouter, _ListenerHub):
+    """Durable INDEXED stats storage on sqlite3 (reference:
+    ui/storage/sqlite/J7FileStatsStorage.java and
+    mapdb/MapDBStatsStorage.java — the reference's durable/indexed tier above
+    the flat file). WAL journal mode gives the concurrent-reader story for
+    long runs: writers go through one serialized connection, while any number
+    of reader connections (other threads OR other processes, e.g. a UI server
+    tailing a live training run) see consistent snapshots without blocking
+    the trainer. Updates are indexed by (session_id, iteration) so range
+    queries don't scan the run history."""
+
+    def __init__(self, path):
+        import sqlite3
+        self.path = str(path)
+        self._sqlite3 = sqlite3
+        self._w = sqlite3.connect(self.path, check_same_thread=False)
+        self._w.execute("PRAGMA journal_mode=WAL")
+        self._w.execute(
+            "CREATE TABLE IF NOT EXISTS static_info ("
+            " session_id TEXT PRIMARY KEY, json TEXT NOT NULL)")
+        self._w.execute(
+            "CREATE TABLE IF NOT EXISTS updates ("
+            " id INTEGER PRIMARY KEY AUTOINCREMENT,"
+            " session_id TEXT NOT NULL,"
+            " iteration INTEGER NOT NULL DEFAULT 0,"
+            " ts REAL NOT NULL DEFAULT 0,"
+            " json TEXT NOT NULL)")
+        self._w.execute(
+            "CREATE INDEX IF NOT EXISTS idx_updates_session_iter"
+            " ON updates (session_id, iteration)")
+        self._w.commit()
+        self._lock = threading.Lock()
+        self._listeners = []
+
+    def _read_conn(self):
+        # short-lived per-call connection: safe from any thread/process
+        return self._sqlite3.connect(self.path, check_same_thread=False)
+
+    # ---- router (write) ---------------------------------------------------
+    def put_static_info(self, report):
+        d = _as_dict(report)
+        with self._lock:
+            self._w.execute(
+                "INSERT OR REPLACE INTO static_info (session_id, json)"
+                " VALUES (?, ?)", (d["session_id"], json.dumps(d)))
+            self._w.commit()
+        self._notify(d)
+
+    def put_update(self, report):
+        d = _as_dict(report)
+        with self._lock:
+            self._w.execute(
+                "INSERT INTO updates (session_id, iteration, ts, json)"
+                " VALUES (?, ?, ?, ?)",
+                (d["session_id"], int(d.get("iteration", 0)),
+                 float(d.get("timestamp", 0.0)), json.dumps(d)))
+            self._w.commit()
+        self._notify(d)
+
+    # ---- storage (read) ---------------------------------------------------
+    def list_session_ids(self):
+        with contextlib.closing(self._read_conn()) as c:
+            rows = c.execute(
+                "SELECT session_id FROM static_info UNION "
+                "SELECT DISTINCT session_id FROM updates").fetchall()
+        return sorted(r[0] for r in rows)
+
+    def get_static_info(self, session_id):
+        with contextlib.closing(self._read_conn()) as c:
+            row = c.execute("SELECT json FROM static_info WHERE session_id=?",
+                            (session_id,)).fetchone()
+        return json.loads(row[0]) if row else None
+
+    def get_all_updates(self, session_id):
+        with contextlib.closing(self._read_conn()) as c:
+            rows = c.execute(
+                "SELECT json FROM updates WHERE session_id=? ORDER BY id",
+                (session_id,)).fetchall()
+        return [json.loads(r[0]) for r in rows]
+
+    def get_latest_update(self, session_id):
+        with contextlib.closing(self._read_conn()) as c:
+            row = c.execute(
+                "SELECT json FROM updates WHERE session_id=?"
+                " ORDER BY id DESC LIMIT 1", (session_id,)).fetchone()
+        return json.loads(row[0]) if row else None
+
+    def get_updates_since(self, session_id, iteration):
+        """Indexed range read (J7FileStatsStorage.getAllUpdatesAfter role)."""
+        with contextlib.closing(self._read_conn()) as c:
+            rows = c.execute(
+                "SELECT json FROM updates WHERE session_id=? AND iteration>?"
+                " ORDER BY iteration", (session_id, int(iteration))).fetchall()
+        return [json.loads(r[0]) for r in rows]
+
+    def count_updates(self, session_id):
+        with contextlib.closing(self._read_conn()) as c:
+            (n,) = c.execute("SELECT COUNT(*) FROM updates WHERE session_id=?",
+                             (session_id,)).fetchone()
+        return n
+
+    def close(self):
+        with self._lock:
+            self._w.close()

@@ -1,0 +1,13 @@
+"""Model zoo: reference architectures + pretrained weight loading.
+
+Reference: deeplearning4j-modelimport trainedmodels/ (TrainedModels.java
+architectures, TrainedModelHelper.java weight fetch+restore,
+Utils/ImageNetLabels.java label decoding).
+"""
+from .models import (lenet_mnist, mlp_mnist, char_rnn_lstm, resnet50,
+                     transformer_lm, vgg16)
+from .pretrained import (Labels, available_pretrained, load_pretrained)
+
+__all__ = ["lenet_mnist", "mlp_mnist", "char_rnn_lstm", "resnet50",
+           "transformer_lm", "vgg16", "Labels", "available_pretrained",
+           "load_pretrained"]

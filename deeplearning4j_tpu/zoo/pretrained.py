@@ -1,0 +1,88 @@
+"""Pretrained zoo weights + label decoding.
+
+Reference: modelimport trainedmodels/TrainedModelHelper.java:1 (downloads a
+zoo architecture's pretrained HDF5 weights, builds the model, returns it
+ready for inference) and Utils/ImageNetLabels.java:1 (class-index -> label
+names, decodePredictions top-5 table).
+
+TPU build: the same machinery against committed weight fixtures — this
+environment has no egress, so ImageNet-scale VGG16 weights cannot be
+fetched; what ships is the full pretrained path exercised end to end on a
+committed LeNet trained on the real-digit MNIST fixture
+(tests/fixtures/pretrained/, built by tools/make_pretrained_fixture.py).
+`load_pretrained()` resolves name -> weights file (PRETRAINED_DIR env
+overrides, so real downloaded weight archives drop in without code
+changes), restores the checkpoint, and `decode_predictions` maps output
+distributions through the model's label table like ImageNetLabels does."""
+from __future__ import annotations
+
+import json
+import os
+
+import numpy as np
+
+_FIXTURE_DIR = os.path.join(os.path.dirname(__file__), os.pardir, os.pardir,
+                            "tests", "fixtures", "pretrained")
+
+
+class Labels:
+    """Class-index -> name table (reference: Utils/ImageNetLabels.java)."""
+
+    def __init__(self, names):
+        self.names = list(names)
+
+    @staticmethod
+    def load(path):
+        with open(path) as f:
+            return Labels(json.load(f))
+
+    def decode_predictions(self, probs, top=5):
+        """[batch, n_classes] -> per-row list of (label, probability),
+        descending (ImageNetLabels.decodePredictions)."""
+        probs = np.asarray(probs)
+        if probs.ndim == 1:
+            probs = probs[None]
+        out = []
+        for row in probs:
+            idx = np.argsort(row)[::-1][:top]
+            out.append([(self.names[i], float(row[i])) for i in idx])
+        return out
+
+
+def _search_dirs():
+    d = os.environ.get("PRETRAINED_DIR")
+    return [p for p in (d, _FIXTURE_DIR) if p]
+
+
+def available_pretrained():
+    """Names with a weights archive on this machine (a label table is
+    optional — load_pretrained returns labels=None when absent, so callers
+    that decode labels must check before using it)."""
+    names = set()
+    for d in _search_dirs():
+        if os.path.isdir(d):
+            for f in os.listdir(d):
+                if f.endswith(".zip"):
+                    names.add(f[:-4])
+    return sorted(names)
+
+
+def load_pretrained(name="lenet_mnist_real", load_updater=False):
+    """Restore a ready-for-inference pretrained model + its Labels
+    (TrainedModelHelper.loadModel analog). Returns (model, labels) where
+    labels is None if no <name>.labels.json sits next to the weights.
+    Raises FileNotFoundError with the searched locations when the weights
+    are absent."""
+    from ..util.model_serializer import ModelSerializer
+    searched = []
+    for d in _search_dirs():
+        zp = os.path.join(d, name + ".zip")
+        lp = os.path.join(d, name + ".labels.json")
+        searched.append(zp)
+        if os.path.exists(zp):
+            model = ModelSerializer.restore(zp, load_updater=load_updater)
+            labels = Labels.load(lp) if os.path.exists(lp) else None
+            return model, labels
+    raise FileNotFoundError(
+        f"no pretrained weights for {name!r}; searched {searched} "
+        f"(set PRETRAINED_DIR to a directory of <name>.zip weight archives)")

@@ -53,3 +53,30 @@ def test_lenet_reaches_95pct_on_real_heldout():
     ev = net.evaluate(test_it)
     acc = ev.accuracy()
     assert acc >= 0.95, f"held-out accuracy {acc:.3f} < 0.95 on real digits"
+
+
+def test_pretrained_zoo_to_labels_pipeline():
+    """VERDICT r3 Missing #3: zoo -> load_pretrained() -> output() ->
+    decode_predictions labels, against the committed weight fixture
+    (TrainedModelHelper + ImageNetLabels mechanism, exercised end to end)."""
+    from deeplearning4j_tpu.zoo import (available_pretrained,
+                                        load_pretrained)
+    assert "lenet_mnist_real" in available_pretrained()
+    net, labels = load_pretrained("lenet_mnist_real")
+    test_it = MnistDataSetIterator(batch_size=500, train=False, shuffle=False)
+    ds = test_it.next()
+    probs = np.asarray(net.output(ds.features))
+    decoded = labels.decode_predictions(probs, top=3)
+    assert len(decoded) == 500 and len(decoded[0]) == 3
+    # top-1 label text must match the true digit >= 95% of the time
+    truth = np.argmax(ds.labels, axis=1)
+    hits = sum(d[0][0] == f"digit {t}" for d, t in zip(decoded, truth))
+    assert hits / len(truth) >= 0.95, f"top-1 label accuracy {hits/500:.3f}"
+    # each row's probabilities are sorted descending
+    assert all(d[0][1] >= d[1][1] >= d[2][1] for d in decoded)
+
+
+def test_load_pretrained_missing_name_reports_search_path():
+    from deeplearning4j_tpu.zoo import load_pretrained
+    with pytest.raises(FileNotFoundError, match="PRETRAINED_DIR"):
+        load_pretrained("vgg16_imagenet")

@@ -92,3 +92,159 @@ def test_inference_server_http():
             assert e.code == 400
     finally:
         server.stop()
+
+
+def test_broker_route_over_real_socket():
+    """VERDICT r3 #6: publish -> broker (TCP) -> ServeRoute -> broker ->
+    consume, all over a real socket (NDArrayKafkaClient route analog)."""
+    import time
+    from deeplearning4j_tpu.streaming import (
+        MessageBroker, BrokerClient, BrokerSource, BrokerSink, ServeRoute)
+    broker = MessageBroker(port=0).start()
+    try:
+        net = _net()
+        producer = BrokerClient(port=broker.port)
+        consumer = BrokerClient(port=broker.port)
+        route = ServeRoute(net, BrokerSource(BrokerClient(port=broker.port),
+                                             "features"),
+                           BrokerSink(BrokerClient(port=broker.port),
+                                      "predictions"))
+        route.start()
+        try:
+            rng = np.random.default_rng(0)
+            xs = [rng.normal(size=(2, 6)).astype(np.float32)
+                  for _ in range(5)]
+            for i, x in enumerate(xs):
+                producer.publish("features", json.loads(
+                    NDArrayMessage(x, {"i": i}).to_json()))
+            got = {}
+            deadline = time.time() + 30
+            while len(got) < 5 and time.time() < deadline:
+                d = consumer.poll("predictions", timeout=1)
+                if d is not None:
+                    m = NDArrayMessage.from_json(d)
+                    got[m.meta["i"]] = m.array
+            assert len(got) == 5, f"only {len(got)}/5 predictions arrived"
+            for i, x in enumerate(xs):
+                np.testing.assert_allclose(got[i], np.asarray(net.output(x)),
+                                           rtol=1e-5, atol=1e-6)
+        finally:
+            route.stop()
+    finally:
+        broker.stop()
+
+
+def test_broker_client_reconnects_after_restart():
+    """A broker restart (same port) must be invisible to the client: the
+    request that hits the dead socket reconnects and retries."""
+    from deeplearning4j_tpu.streaming import MessageBroker, BrokerClient
+    broker = MessageBroker(port=0).start()
+    port = broker.port
+    client = BrokerClient(port=port, retries=40, retry_interval=0.1)
+    try:
+        client.publish("t", {"n": 1})
+        assert client.poll("t")["n"] == 1
+        broker.stop()
+        broker = MessageBroker(port=port).start()  # restart on the same port
+        client.publish("t", {"n": 2})              # must reconnect + retry
+        assert client.poll("t", timeout=2)["n"] == 2
+    finally:
+        client.close()
+        broker.stop()
+
+
+def test_broker_unreachable_raises_after_retries():
+    from deeplearning4j_tpu.streaming import BrokerClient
+    import pytest as _pytest
+    client = BrokerClient(port=1, retries=1, retry_interval=0.01)
+    with _pytest.raises(ConnectionError, match="unreachable"):
+        client.publish("t", {})
+
+
+def test_broker_dead_letter_envelopes_over_socket():
+    """A bad record mid-stream yields an error envelope on the prediction
+    topic (Camel dead-letter analog) and the route keeps serving."""
+    import time
+    from deeplearning4j_tpu.streaming import (
+        MessageBroker, BrokerClient, BrokerSource, BrokerSink, ServeRoute)
+    broker = MessageBroker(port=0).start()
+    try:
+        net = _net()
+        producer = BrokerClient(port=broker.port)
+        consumer = BrokerClient(port=broker.port)
+        route = ServeRoute(net, BrokerSource(BrokerClient(port=broker.port),
+                                             "in"),
+                           BrokerSink(BrokerClient(port=broker.port), "out"),
+                           max_batch=1)
+        route.start()
+        try:
+            rng = np.random.default_rng(1)
+            producer.publish("in", json.loads(NDArrayMessage(
+                rng.normal(size=(1, 999)).astype(np.float32),  # wrong width
+                {"i": "bad"}).to_json()))
+            producer.publish("in", json.loads(NDArrayMessage(
+                rng.normal(size=(1, 6)).astype(np.float32),
+                {"i": "good"}).to_json()))
+            seen = {}
+            deadline = time.time() + 30
+            while len(seen) < 2 and time.time() < deadline:
+                d = consumer.poll("out", timeout=1)
+                if d is not None:
+                    m = NDArrayMessage.from_json(d)
+                    seen[m.meta["i"]] = m
+            assert "error" in seen["bad"].meta
+            assert seen["bad"].array.size == 0
+            assert seen["good"].array.shape == (1, 3)
+            assert "error" not in seen["good"].meta
+        finally:
+            route.stop()
+    finally:
+        broker.stop()
+
+
+def test_broker_cross_process():
+    """Broker in another PROCESS, client here: the route shape the reference
+    runs against an external Kafka cluster."""
+    import subprocess, sys, time
+    from deeplearning4j_tpu.streaming import BrokerClient
+    code = (
+        "import sys; sys.path.insert(0, %r)\n"
+        "from deeplearning4j_tpu.streaming import MessageBroker\n"
+        "import time\n"
+        "b = MessageBroker(port=0).start()\n"
+        "print(b.port, flush=True)\n"
+        "time.sleep(60)\n" % (str(__import__('pathlib').Path(__file__).resolve().parents[1]),))
+    proc = subprocess.Popen([sys.executable, "-c", code],
+                            stdout=subprocess.PIPE, text=True)
+    try:
+        port = int(proc.stdout.readline().strip())
+        client = BrokerClient(port=port)
+        client.publish("xp", {"hello": "across processes"})
+        assert client.poll("xp", timeout=5)["hello"] == "across processes"
+        assert client.stats()["xp"] == 0
+        client.close()
+    finally:
+        proc.kill()
+
+
+def test_broker_publish_retry_is_idempotent():
+    """A pub retried after a lost ok-response (same id) must not enqueue the
+    record twice; a long client poll timeout is served by looped short
+    server-side waits (never stranding a handler past the socket timeout)."""
+    from deeplearning4j_tpu.streaming import MessageBroker, BrokerClient
+    broker = MessageBroker(port=0).start()
+    try:
+        client = BrokerClient(port=broker.port)
+        req = {"op": "pub", "topic": "idem", "msg": {"v": 1}, "id": "fixed"}
+        assert client._request(req)["ok"]
+        assert client._request(req).get("dup")  # simulated retry
+        assert client.stats()["idem"] == 1
+        assert client.poll("idem")["v"] == 1
+        assert client.poll("idem", timeout=0.2) is None  # no duplicate
+        # long-poll cap: timeout beyond MAX_POLL_S still returns (looped)
+        import time
+        t0 = time.monotonic()
+        assert client.poll("idem", timeout=6.5) is None
+        assert 6.0 < time.monotonic() - t0 < 12.0
+    finally:
+        broker.stop()
