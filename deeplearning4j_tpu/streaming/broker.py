@@ -42,6 +42,10 @@ import time
 import uuid
 
 
+class BrokerError(RuntimeError):
+    """Broker-side rejection (unknown op, malformed frame, ...)."""
+
+
 def _send_frame(sock, obj):
     payload = json.dumps(obj).encode("utf-8")
     sock.sendall(struct.pack(">I", len(payload)) + payload)
@@ -148,14 +152,21 @@ class MessageBroker:
                         _send_frame(self.request, resp)
                     except OSError:
                         # a record dequeued for a poller whose socket died
-                        # must go back on the topic, not vanish
+                        # must go back on the topic, not vanish — including
+                        # when the topic refilled meanwhile (drop the oldest
+                        # to make room, same policy as pub)
                         if req.get("op") == "poll" and resp.get("msg") \
                                 is not None:
-                            try:
-                                broker._topic(req["topic"]).put_nowait(
-                                    resp["msg"])
-                            except queue.Full:
-                                pass
+                            q = broker._topic(req["topic"])
+                            while True:
+                                try:
+                                    q.put_nowait(resp["msg"])
+                                    break
+                                except queue.Full:
+                                    try:
+                                        q.get_nowait()
+                                    except queue.Empty:
+                                        pass
                         return
 
         class Server(socketserver.ThreadingTCPServer):
@@ -207,6 +218,10 @@ class BrokerClient:
                     resp = _recv_frame(self._sock)
                     if resp is None:
                         raise ConnectionError("broker closed the connection")
+                    if isinstance(resp, dict) and "error" in resp:
+                        # broker-side rejection is a hard error, not a retry
+                        # case — surface it instead of a KeyError downstream
+                        raise BrokerError(resp["error"])
                     return resp
                 except (OSError, ConnectionError) as e:
                     last = e
@@ -231,11 +246,12 @@ class BrokerClient:
         """Long-poll by looping short server-side waits (each bounded by the
         broker's MAX_POLL_S, far under the socket timeout — a long client
         timeout can never strand a blocked handler holding a record)."""
+        cap = MessageBroker.MAX_POLL_S  # single source for both caps
         deadline = time.monotonic() + float(timeout or 0)
         while True:
             remaining = deadline - time.monotonic()
             msg = self._request({"op": "poll", "topic": topic,
-                                 "timeout": max(0, min(remaining, 5.0))})["msg"]
+                                 "timeout": max(0, min(remaining, cap))})["msg"]
             if msg is not None or time.monotonic() >= deadline:
                 return msg
 
@@ -279,7 +295,7 @@ class BrokerSink(StreamSink):
         self.topic = topic
 
     def publish(self, message):
-        self.client.publish(self.topic, json.loads(message.to_json()))
+        self.client.publish(self.topic, message.to_dict())
 
     def close(self):
         self.client.close()

@@ -38,9 +38,15 @@ class NDArrayMessage:
         self.array = np.asarray(array)
         self.meta = dict(meta or {})
 
+    def to_dict(self) -> dict:
+        a = np.ascontiguousarray(self.array)
+        return {"array": {"shape": list(a.shape), "dtype": a.dtype.name,
+                          "data": base64.b64encode(a.tobytes())
+                          .decode("ascii")},
+                "meta": self.meta}
+
     def to_json(self) -> str:
-        return json.dumps({"array": json.loads(serialize_array(self.array)),
-                           "meta": self.meta})
+        return json.dumps(self.to_dict())
 
     @staticmethod
     def from_json(payload) -> "NDArrayMessage":
