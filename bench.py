@@ -248,8 +248,15 @@ def bench_resnet50_end_to_end(compute_step_ms, batch=256, image=224,
     _sync(net._score_dev)
     wall_ms = (time.perf_counter() - t0) * 1e3 / n_batches
     e2e_sps = batch / (wall_ms / 1e3)
-    overlap = ((link_ms + compute_step_ms - wall_ms)
-               / max(min(link_ms, compute_step_ms), 1e-9))
+    legs = sorted((link_ms, compute_step_ms))
+    if legs[1] > 10 * legs[0]:
+        # the smaller leg is inside the bigger leg's measurement noise
+        # (~3x on this relay link): the hidden-fraction ratio would be
+        # meaningless, so report it as undefined — the overlap property
+        # itself is asserted on the CPU backend (tests/test_iterators.py)
+        overlap = None
+    else:
+        overlap = (link_ms + compute_step_ms - wall_ms) / max(legs[0], 1e-9)
     return e2e_sps, h2d_mb_s, link_ms, wall_ms, overlap
 
 
@@ -580,7 +587,8 @@ def main():
                 extras["h2d_mb_per_sec"] = round(r[1], 1)
                 extras["e2e_link_ms"] = round(r[2], 1)
                 extras["e2e_wall_ms_per_batch"] = round(r[3], 1)
-                extras["e2e_overlap"] = round(r[4], 2)
+                if r[4] is not None:
+                    extras["e2e_overlap"] = round(r[4], 2)
                 extras["e2e_vs_compute"] = round(r[0] / value, 3)
             elif name == "lenet":
                 extras["lenet_samples_per_sec"] = round(r[0], 1)

@@ -18,9 +18,10 @@ subsumed by BrokerSource -> ServeRoute -> BrokerSink composition.
 from .serde import NDArrayMessage, serialize_array, deserialize_array
 from .routes import StreamSource, StreamSink, QueueSource, QueueSink, ServeRoute
 from .serve import InferenceServer
-from .broker import (MessageBroker, BrokerClient, BrokerSource, BrokerSink)
+from .broker import (MessageBroker, BrokerClient, BrokerError,
+                     BrokerSource, BrokerSink)
 
 __all__ = ["NDArrayMessage", "serialize_array", "deserialize_array",
            "StreamSource", "StreamSink", "QueueSource", "QueueSink",
            "ServeRoute", "InferenceServer", "MessageBroker", "BrokerClient",
-           "BrokerSource", "BrokerSink"]
+           "BrokerError", "BrokerSource", "BrokerSink"]

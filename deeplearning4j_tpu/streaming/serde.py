@@ -15,13 +15,15 @@ import json
 import numpy as np
 
 
+def _array_envelope(arr) -> dict:
+    """The one definition of the wire envelope {shape, dtype, data}."""
+    a = np.ascontiguousarray(np.asarray(arr))
+    return {"shape": list(a.shape), "dtype": a.dtype.name,
+            "data": base64.b64encode(a.tobytes()).decode("ascii")}
+
+
 def serialize_array(arr) -> str:
-    a = np.asarray(arr)
-    return json.dumps({
-        "shape": list(a.shape),
-        "dtype": a.dtype.name,
-        "data": base64.b64encode(np.ascontiguousarray(a).tobytes()).decode("ascii"),
-    })
+    return json.dumps(_array_envelope(arr))
 
 
 def deserialize_array(payload) -> np.ndarray:
@@ -39,11 +41,7 @@ class NDArrayMessage:
         self.meta = dict(meta or {})
 
     def to_dict(self) -> dict:
-        a = np.ascontiguousarray(self.array)
-        return {"array": {"shape": list(a.shape), "dtype": a.dtype.name,
-                          "data": base64.b64encode(a.tobytes())
-                          .decode("ascii")},
-                "meta": self.meta}
+        return {"array": _array_envelope(self.array), "meta": self.meta}
 
     def to_json(self) -> str:
         return json.dumps(self.to_dict())

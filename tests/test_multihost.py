@@ -87,9 +87,18 @@ def test_two_process_data_parallel_matches_single_process(tmp_path):
                               stderr=subprocess.STDOUT, text=True)
              for i in range(2)]
     outs = []
-    for p in procs:
-        out, _ = p.communicate(timeout=260)
-        outs.append(out)
+    try:
+        for p in procs:
+            out, _ = p.communicate(timeout=260)
+            outs.append(out)
+    finally:
+        # a hung worker (e.g. coordinator port collision) must not outlive
+        # the test holding the port; salvage whatever output exists
+        for i, p in enumerate(procs):
+            if p.poll() is None:
+                p.kill()
+                out, _ = p.communicate()
+                print(f"--- killed hung process {i}; output:\n{out[-3000:]}")
     for i, (p, out) in enumerate(zip(procs, outs)):
         assert p.returncode == 0, f"process {i} failed:\n{out[-3000:]}"
         assert f"{i} MULTIHOST-OK" in out
