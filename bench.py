@@ -353,11 +353,13 @@ def bench_transformer_lm(batch=16, seq=512, vocab=256, steps=10, warmup=2):
     return batch * seq * steps / max(total, 1e-9)
 
 
-def bench_flash_attention(B=4, H=8, T=2048, D=64, steps=10):
+def bench_flash_attention(B=4, H=8, T=4096, D=64, steps=10):
     """Pallas flash-attention kernel vs the einsum reference, fwd+bwd on the
-    real chip (compiled, not interpret). Reports per-call ms for both paths
-    and the compiled temp memory of each (the [T,T] score materialization is
-    the reference's cost; flash holds only block tiles + the LSE residual)."""
+    real chip (compiled, not interpret), both paths best-of-3 in the SAME
+    run (the relay drifts minutes apart). T=4096 is where the long-context
+    story lives: the reference materializes a 2.1 GB [T,T] score temp, flash
+    holds 236 MB of block tiles + the LSE residual, and ran 1.3-2x faster
+    across sessions (one transient slow-relay phase measured it behind)."""
     import jax
     import jax.numpy as jnp
     from deeplearning4j_tpu.kernels.flash_attention import flash_attention
@@ -384,11 +386,15 @@ def bench_flash_attention(B=4, H=8, T=2048, D=64, steps=10):
         g = make(fn)
         dq, _, _ = g(q, k, v)
         _sync(dq[0, 0, 0, 0])
-        t0 = time.perf_counter()
-        for _ in range(steps):
-            dq, dk, dv = g(q, k, v)
-        _sync(dq[0, 0, 0, 0])
-        out[name + "_ms"] = ((time.perf_counter() - t0) * 1e3 - floor_ms) / steps
+
+        def timed(g=g):
+            t0 = time.perf_counter()
+            for _ in range(steps):
+                dq, dk, dv = g(q, k, v)
+            _sync(dq[0, 0, 0, 0])
+            return time.perf_counter() - t0
+
+        out[name + "_ms"] = (_best_of(3, timed) * 1e3 - floor_ms) / steps
         comp = g.lower(q, k, v).compile()
         out[name + "_temp_mb"] = comp.memory_analysis().temp_size_in_bytes / 1e6
     out["speedup"] = out["reference_ms"] / out["flash_ms"]

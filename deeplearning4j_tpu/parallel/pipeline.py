@@ -6,13 +6,15 @@ into S contiguous stages, each stage's parameters live on their own device,
 and every global batch is fed as M microbatches.
 
 Execution model: every unit of stage work is ONE jitted XLA executable —
-forward `fwd(pslice, x, rng) -> act`, backward
-`bwd(pslice, x, rng, cot) -> (grads, dx)` (activation-recompute: the
-backward replays the stage forward inside the same executable, so residuals
-never cross the jit boundary and per-microbatch live state is just the stage
-INPUT + one cotangent), a fused last-stage `loss_and_grads`, and a donated
-per-stage optimizer update. The host only ENQUEUES these executables — in
-the interleaved one-forward-one-backward (1F1B / PipeDream-flush) order —
+forward `fwd(pslice, sslice, x, rng) -> (act, new_states)`, backward
+`bwd(pslice, sslice, x, rng, cot) -> (grads, dx)` (activation-recompute:
+the backward replays the stage forward inside the same executable from the
+same input and state snapshot, so residuals never cross the jit boundary
+and per-microbatch live state is just the stage INPUT + the channel-sized
+state snapshot + one cotangent), a fused last-stage
+`(loss, new_states, grads, dx)`, and a donated per-stage optimizer update.
+The host only ENQUEUES these executables — in the interleaved
+one-forward-one-backward (1F1B / PipeDream-flush) order —
 and never blocks: JAX async dispatch keeps every stage device's queue busy
 while later microbatches stream in, which is what bounds in-flight
 microbatches to ~S instead of GPipe's M and lets stage s run microbatch m's
@@ -20,14 +22,19 @@ forward while stage s+1 runs m-1's backward. The overlap is a tested
 property (tests/test_parallel.py: pipelined wall vs the same executables
 host-fenced).
 
-Equivalence contract (tested): with mean losses and equal microbatches,
-pipeline training over S stages x M microbatches produces the SAME parameter
-update as single-device full-batch training.
+Equivalence contract (tested): for stateless layer stacks, with mean losses
+and equal microbatches, pipeline training over S stages x M microbatches
+produces the SAME parameter update as single-device full-batch training.
 
-Stateful layers (BatchNormalization running stats) are REJECTED by default:
-stage executables treat layer state as frozen, so training such a model
-would silently diverge from fit()'s semantics. Pass allow_stale_state=True
-to accept frozen statistics knowingly, or train with ShardedTrainer.
+Stateful layers (BatchNormalization running stats) are SUPPORTED with
+per-microbatch semantics, the standard pipeline-parallel behavior: each
+microbatch normalizes with its own batch statistics and applies one EMA
+update to the running stats, chained in microbatch order within a stage
+(exactly M sequential microbatch-sized steps' worth of state; tested
+against that oracle). This necessarily differs from single-device
+FULL-batch statistics — a model with BN trained under a pipeline sees
+microbatch-sized normalization, the same trade every 1F1B implementation
+makes.
 """
 from __future__ import annotations
 
@@ -41,7 +48,7 @@ from ..nn.updaters import apply_gradient_normalization
 
 class PipelineTrainer:
     def __init__(self, model, n_stages=2, n_microbatches=4, devices=None,
-                 boundaries=None, allow_stale_state=False):
+                 boundaries=None):
         """boundaries: optional explicit stage split points (layer indices);
         default splits layers evenly. devices: one per stage (defaults to the
         first n_stages of jax.devices())."""
@@ -69,15 +76,9 @@ class PipelineTrainer:
         if len(self.devices) < self.n_stages:
             raise ValueError(f"need {self.n_stages} devices, have "
                              f"{len(self.devices)}")
-        if (not allow_stale_state and any(
-                jax.tree_util.tree_leaves(v) for v in model.states.values())):
-            raise ValueError(
-                "PipelineTrainer compiles per-stage steps with layer state "
-                "frozen (BatchNormalization running statistics would go "
-                "stale); train stateful models with fit()/ShardedTrainer, "
-                "or pass allow_stale_state=True to accept frozen stats")
         self._place_stages()
         self._jits = {}
+        self._needs_placement = False
         self._fence_every_op = False  # test hook: defeat async overlap
 
     # ------------------------------------------------------------ placement
@@ -95,8 +96,9 @@ class PipelineTrainer:
                 m.opt_state[k] = jax.device_put(m.opt_state[k], dev)
 
     # --------------------------------------------------- stage executables
-    def _run_layers(self, pslice, feats, rng, layer_idxs):
+    def _run_layers(self, pslice, sslice, feats, rng, layer_idxs):
         m = self.model
+        new_states = {}
         for i in layer_idxs:
             pre = m.conf.input_preprocessors.get(i)
             if rng is not None:
@@ -105,49 +107,56 @@ class PipelineTrainer:
                 pre_rng = sub = None
             if pre is not None:
                 feats = pre(feats, None, rng=pre_rng)
-            feats, _, _ = m.layers[i].forward(
-                pslice[str(i)], m.states[str(i)], feats,
+            feats, new_states[str(i)], _ = m.layers[i].forward(
+                pslice[str(i)], sslice[str(i)], feats,
                 train=True, rng=sub)[:3]
-        return feats
+        return feats, new_states
 
     def _mid_forward_fn(self, s):
         """Pure forward of a non-final stage (mixed precision mirrors the
-        single-device step: hidden layers run in the compute dtype)."""
+        single-device step: hidden layers run in the compute dtype; layer
+        state — BN running stats — stays in its own dtype and threads
+        through as an explicit argument)."""
         m = self.model
         idxs = list(self._stage_layers(s))
         cd = m._compute_dtype()
 
-        def fn(pslice, x, rng):
+        def fn(pslice, sslice, x, rng):
             if cd is not None:
                 pslice = m._cast_floats(pslice, cd)
                 x = x.astype(cd) if jnp.issubdtype(x.dtype, jnp.floating) \
                     else x
-            return self._run_layers(pslice, x, rng, idxs)
+            return self._run_layers(pslice, sslice, x, rng, idxs)
         return fn
 
     def _last_forward_fn(self, s):
-        """Mean loss of the final stage (output layer + loss in f32)."""
+        """(mean loss, new states) of the final stage (output layer + loss
+        in f32)."""
         m = self.model
         idxs = list(self._stage_layers(s))
         cd = m._compute_dtype()
 
-        def fn(pslice, x, y, rng):
+        def fn(pslice, sslice, x, y, rng):
             out_i = idxs[-1]
             if cd is not None:
                 pslice = {k: (v if k == str(out_i) else m._cast_floats(v, cd))
                           for k, v in pslice.items()}
                 x = x.astype(cd) if jnp.issubdtype(x.dtype, jnp.floating) \
                     else x
-            feats = self._run_layers(pslice, x, rng, idxs[:-1])
+            feats, new_states = self._run_layers(pslice, sslice, x, rng,
+                                                 idxs[:-1])
             feats2, _ = m._apply_preprocessor(out_i, feats, None)
             if cd is not None:
                 feats2 = feats2.astype(m._dtype)
-            return m.layers[out_i].score(pslice[str(out_i)], feats2, y, None,
+            loss = m.layers[out_i].score(pslice[str(out_i)], feats2, y, None,
                                          True, None)
+            new_states[str(out_i)] = sslice[str(out_i)]
+            return loss, new_states
         return fn
 
     def _fwd(self, s):
-        """Jitted forward executable for a non-final stage."""
+        """Jitted forward executable for a non-final stage:
+        (pslice, sslice, x, rng) -> (act, new_states)."""
         key = ("fwd", s)
         if key not in self._jits:
             self._jits[key] = jax.jit(self._mid_forward_fn(s))
@@ -155,14 +164,17 @@ class PipelineTrainer:
 
     def _bwd(self, s):
         """Jitted backward executable for a non-final stage: recomputes the
-        stage forward from its input (same rng => identical activations) and
-        pulls the cotangent through — (param grads, input cotangent)."""
+        stage forward from its input (same rng and same input states =>
+        identical activations) and pulls the cotangent through —
+        (param grads, input cotangent). Train-mode layer outputs normalize
+        with batch statistics, so gradients don't flow into the state."""
         key = ("bwd", s)
         if key not in self._jits:
             fwd = self._mid_forward_fn(s)
 
-            def fn(pslice, x, rng, cot):
-                _, vjp = jax.vjp(lambda p, a: fwd(p, a, rng), pslice, x)
+            def fn(pslice, sslice, x, rng, cot):
+                _, vjp = jax.vjp(lambda p, a: fwd(p, sslice, a, rng)[0],
+                                 pslice, x)
                 gp, gx = vjp(cot)
                 return gp, gx
             self._jits[key] = jax.jit(fn)
@@ -175,10 +187,12 @@ class PipelineTrainer:
         if key not in self._jits:
             lfn = self._last_forward_fn(s)
 
-            def fn(pslice, x, y, rng):
-                loss, vjp = jax.vjp(lambda p, a: lfn(p, a, y, rng), pslice, x)
+            def fn(pslice, sslice, x, y, rng):
+                loss, vjp, new_states = jax.vjp(
+                    lambda p, a: lfn(p, sslice, a, y, rng), pslice, x,
+                    has_aux=True)
                 gp, gx = vjp(jnp.ones((), loss.dtype))
-                return loss, gp, gx
+                return loss, new_states, gp, gx
             self._jits[key] = jax.jit(fn)
         return self._jits[key]
 
@@ -218,6 +232,23 @@ class PipelineTrainer:
             jax.block_until_ready(x)
         return x
 
+    def gather(self, device=None):
+        """Re-colocate params/state/opt-state on ONE device (default: the
+        first stage's) so the model's own jitted inference/serialization
+        paths work after pipeline training — `output()` on a model whose
+        stages live on different devices fails placement checks. Returns
+        the model; call `_place_stages` via a new fit_batch to resume
+        pipelined training (placement is re-asserted every construction,
+        so simply creating a new PipelineTrainer also works)."""
+        m = self.model
+        dev = device or self.devices[0]
+        put = lambda t: jax.tree_util.tree_map(
+            lambda a: jax.device_put(a, dev), t)
+        m.params, m.states, m.opt_state = (put(m.params), put(m.states),
+                                           put(m.opt_state))
+        self._needs_placement = True  # fit_batch re-asserts stage placement
+        return m
+
     # -------------------------------------------------------------- train
     def fit_batch(self, ds):
         """One pipelined step. The host enqueues compiled stage executables
@@ -225,6 +256,9 @@ class PipelineTrainer:
         followed by backward diagonal t-(S-1) — then the donated per-stage
         updates; nothing blocks until the caller reads the score."""
         m = self.model
+        if self._needs_placement:  # model was gather()ed since last step
+            self._place_stages()
+            self._needs_placement = False
         x_np = np.asarray(ds.features)
         y_np = np.asarray(ds.labels)
         B = x_np.shape[0]
@@ -241,6 +275,9 @@ class PipelineTrainer:
             M, S, -1)
 
         stage_in = {}           # (m, s) -> stage input, freed after backward
+        fwd_states = {}         # (m, s) -> state the forward consumed
+        cur_states = [{str(i): m.states[str(i)] for i in self._stage_layers(s)}
+                      for s in range(S)]
         cot = [None] * M        # inbound cotangent per microbatch
         grad_acc = [None] * S
         losses = []
@@ -256,8 +293,10 @@ class PipelineTrainer:
             x = stage_in[(mb, s)]
             r = jax.device_put(mb_rngs[mb, s], self.devices[s])
             if s == S - 1:
+                # fused fwd+bwd: no snapshot needed for a later recompute
                 y = jax.device_put(jnp.asarray(ys[mb]), self.devices[s])
-                loss, gp, gx = self._last(s)(pslices[s], x, y, r)
+                loss, new_states, gp, gx = self._last(s)(
+                    pslices[s], cur_states[s], x, y, r)
                 losses.append(loss)
                 acc(s, gp)
                 if S > 1:
@@ -265,17 +304,23 @@ class PipelineTrainer:
                 del stage_in[(mb, s)]
                 self._maybe_fence(loss)
             else:
-                out = self._fwd(s)(pslices[s], x, r)
+                # snapshot what this forward consumed: the backward
+                # recompute must see the same input state
+                fwd_states[(mb, s)] = cur_states[s]
+                out, new_states = self._fwd(s)(pslices[s], cur_states[s], x, r)
                 stage_in[(mb, s + 1)] = jax.device_put(out,
                                                        self.devices[s + 1])
                 self._maybe_fence(out)
+            # running stats chain in microbatch order within the stage
+            cur_states[s] = new_states
 
         def run_b(mb, s):
             if s == S - 1:
                 return  # fused into run_f
             x = stage_in.pop((mb, s))
             r = jax.device_put(mb_rngs[mb, s], self.devices[s])
-            gp, gx = self._bwd(s)(pslices[s], x, r, cot[mb])
+            gp, gx = self._bwd(s)(pslices[s], fwd_states.pop((mb, s)), x, r,
+                                  cot[mb])
             acc(s, gp)
             cot[mb] = jax.device_put(gx, self.devices[s - 1]) if s > 0 \
                 else None
@@ -299,6 +344,10 @@ class PipelineTrainer:
         for u in range(M, M + S - 1):
             bwd_diagonal(u)
 
+        # commit the chained per-stage states back onto the model
+        for s in range(S):
+            for k, v in cur_states[s].items():
+                m.states[k] = v
         # per-stage donated updates (enqueued on each stage's own device)
         for s in range(S):
             oslice = {str(i): m.opt_state[str(i)]

@@ -297,24 +297,41 @@ def test_pipeline_three_stages_four_layers_no_empty_stage():
         PipelineTrainer(MultiLayerNetwork(conf).init(), n_stages=5)
 
 
-def test_pipeline_rejects_stateful_layers_by_default():
-    """BatchNormalization running stats would silently freeze inside the
-    compiled stage executables -> hard error unless explicitly accepted."""
+def test_pipeline_updates_bn_running_stats_per_microbatch():
+    """Stateful layers thread through the compiled stages: BatchNorm running
+    stats after one pipelined step must equal M sequential microbatch EMA
+    updates (the per-microbatch semantics every 1F1B implementation has).
+    BN is placed FIRST so the oracle depends only on the raw inputs."""
     from deeplearning4j_tpu.parallel.pipeline import PipelineTrainer
     from deeplearning4j_tpu.nn.conf.layers import BatchNormalization
     conf = (NeuralNetConfiguration.builder().seed(4).updater(Sgd(0.1)).list()
-            .layer(DenseLayer(n_out=8, activation="relu"))
             .layer(BatchNormalization())
+            .layer(DenseLayer(n_out=8, activation="relu"))
             .layer(OutputLayer(n_out=3, activation="softmax", loss="MCXENT"))
             .input_type(InputType.feed_forward(8))
             .build())
-    with pytest.raises(ValueError, match="stale"):
-        PipelineTrainer(MultiLayerNetwork(conf).init(), n_stages=2)
-    # explicit opt-in constructs (stats knowingly frozen)
-    pt = PipelineTrainer(MultiLayerNetwork(conf).init(), n_stages=2,
-                         allow_stale_state=True)
-    X, Y = _toy(n=8)
+    net = MultiLayerNetwork(conf).init()
+    M = 4
+    pt = PipelineTrainer(net, n_stages=2, n_microbatches=M,
+                         devices=jax.devices()[:2])
+    X, Y = _toy(n=32)
     assert np.isfinite(pt.fit_batch(DataSet(X, Y)))
+
+    decay = 0.9
+    mean, var = np.zeros(8), np.ones(8)  # BN state init
+    for xm in np.split(X, M):
+        mu = xm.mean(axis=0)
+        mean = decay * mean + (1 - decay) * mu
+        var = decay * var + (1 - decay) * ((xm - mu) ** 2).mean(axis=0)
+    np.testing.assert_allclose(np.asarray(net.states["0"]["mean"]), mean,
+                               rtol=1e-5, atol=1e-6)
+    np.testing.assert_allclose(np.asarray(net.states["0"]["var"]), var,
+                               rtol=1e-5, atol=1e-6)
+    # and training continues to make progress with BN in the pipeline
+    s0 = float(net.score_value)
+    for _ in range(10):
+        pt.fit_batch(DataSet(X, Y))
+    assert float(net.score_value) < s0
 
 
 def test_pipeline_async_schedule_overlaps_stages():
@@ -372,3 +389,34 @@ def test_pipeline_async_schedule_overlaps_stages():
     assert min(ratios) < 0.95, (
         f"pipelined/fenced wall ratios {ratios} never under 0.95 — stage "
         f"execution is not overlapping")
+
+
+def test_pipeline_gather_enables_inference_and_training_resumes():
+    """Stage params live on different devices during pipeline training, so
+    the model's own jitted output() fails placement checks; gather() brings
+    everything to one device for inference/serialization, and the next
+    fit_batch transparently re-places the stages."""
+    from deeplearning4j_tpu.parallel.pipeline import PipelineTrainer
+    from deeplearning4j_tpu.nn.conf.layers import BatchNormalization
+    conf = (NeuralNetConfiguration.builder().seed(4).updater(Sgd(0.05)).list()
+            .layer(DenseLayer(n_out=32, activation="relu"))
+            .layer(BatchNormalization())
+            .layer(DenseLayer(n_out=16, activation="relu"))
+            .layer(OutputLayer(n_out=3, activation="softmax", loss="MCXENT"))
+            .input_type(InputType.feed_forward(8))
+            .build())
+    net = MultiLayerNetwork(conf).init()
+    pt = PipelineTrainer(net, n_stages=2, n_microbatches=4,
+                         devices=jax.devices()[:2])
+    X, Y = _toy(n=32)
+    pt.fit_batch(DataSet(X, Y))
+    with pytest.raises(ValueError, match="devices"):
+        net.output(X)                   # split placement: must be explicit
+    pt.gather()
+    out = np.asarray(net.output(X))     # inference uses the running stats
+    assert out.shape == (32, 3) and np.isfinite(out).all()
+    s = pt.fit_batch(DataSet(X, Y))     # training resumes (re-placement)
+    assert np.isfinite(s)
+    d0 = list(net.params["0"].values())[0].devices()
+    d3 = list(net.params["3"].values())[0].devices()
+    assert d0 != d3, "stages were not re-placed after gather()"
