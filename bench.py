@@ -384,17 +384,15 @@ def bench_flash_attention(B=4, H=8, T=4096, D=64, steps=10):
     for name, fn in (("flash", flash_attention),
                      ("reference", attention_reference)):
         g = make(fn)
-        dq, _, _ = g(q, k, v)
-        _sync(dq[0, 0, 0, 0])
+        res = {"dq": g(q, k, v)[0]}
+        _sync(res["dq"][0, 0, 0, 0])
 
-        def timed(g=g):
-            t0 = time.perf_counter()
-            for _ in range(steps):
-                dq, dk, dv = g(q, k, v)
-            _sync(dq[0, 0, 0, 0])
-            return time.perf_counter() - t0
+        def run(i, g=g, res=res):
+            res["dq"] = g(q, k, v)[0]
 
-        out[name + "_ms"] = (_best_of(3, timed) * 1e3 - floor_ms) / steps
+        total = _time_steps(run, steps,
+                            lambda res=res: _sync(res["dq"][0, 0, 0, 0]))
+        out[name + "_ms"] = (total * 1e3 - floor_ms) / steps
         comp = g.lower(q, k, v).compile()
         out[name + "_temp_mb"] = comp.memory_analysis().temp_size_in_bytes / 1e6
     out["speedup"] = out["reference_ms"] / out["flash_ms"]
