@@ -305,8 +305,11 @@ def bench_mnist_real_accuracy(epochs=6):
 def bench_char_rnn(batch=64, seq=200, vocab=80, steps=10, warmup=2):
     """BASELINE #3: GravesLSTM char-RNN TBPTT training throughput
     (chars/sec; the reference hot loop is LSTMHelpers.java:172-174 per-step
-    gemms — here one lax.scan over fused gemms, bf16 would change numerics of
-    the carried state so f32 is kept)."""
+    gemms — here one lax.scan over fused gemms). f32 by MEASUREMENT, not
+    fear: compute_dtype="bfloat16" now runs safely (f32 carry, bf16 gemms)
+    but benched SLOWER on the v5e at hidden 256 (222k vs 298k chars/s) and
+    1024 (179k vs 193k) — the per-step carry casts outweigh the MXU win at
+    scan-sized recurrent gemms."""
     import jax.numpy as jnp
     from deeplearning4j_tpu.zoo.models import char_rnn_lstm
     from deeplearning4j_tpu.datasets.dataset import DataSet

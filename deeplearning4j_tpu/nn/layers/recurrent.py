@@ -48,23 +48,38 @@ def _init_lstm_params(rng, n_in, n_out, conf, dtype, peephole):
 
 
 def _lstm_scan(params, x, h0, c0, gate_act, cell_act, peephole, mask=None, reverse=False):
-    """x: [b,t,n_in] -> outputs [b,t,n_out], final (h,c)."""
+    """x: [b,t,n_in] -> outputs [b,t,n_out], final (h,c).
+
+    Mixed precision: under bf16 compute the GEMMs run bf16 on the MXU and
+    all gate arithmetic plus the CELL state accumulate in f32 — a bf16 cell
+    carry drifts over the sequence (c_new = f*c + i*g compounds rounding
+    every step; the reference's tuned LSTM keeps full-precision state for
+    the same reason, LSTMHelpers.java). The HIDDEN carry stays in the
+    compute dtype: h is fully re-derived from c each step (h = o*tanh(c),
+    nothing compounds), and keeping it bf16 feeds the recurrent gemm
+    without a per-step cast. Final carries return in the accumulation dtype
+    so TBPTT windows see ONE stable carry dtype (no per-window retrace, no
+    bf16 quantization of the cell state at window boundaries)."""
     n_out = params["RW"].shape[0]
     gate_fn = get_activation(gate_act)
     act_fn = get_activation(cell_act)
     W, RW, b = params["W"], params["RW"], params["b"]
     P = params.get("P")
+    out_dt = x.dtype
+    acc_dt = jnp.float32 if out_dt == jnp.bfloat16 else out_dt
+    if P is not None:
+        P = P.astype(acc_dt)
 
     def step(carry, inputs):
-        h_prev, c_prev = carry
+        h_prev, c_prev = carry            # out_dt, acc_dt
         if mask is not None:
             xz_t, m_t = inputs
         else:
             xz_t, m_t = inputs, None
         # the input projection was hoisted out of the scan (one [b*t, n_in]
         # gemm instead of t small ones — the MXU-friendly schedule); only the
-        # recurrent gemm stays sequential
-        z = xz_t + h_prev @ RW
+        # recurrent gemm stays sequential (out_dt on the MXU, f32 out)
+        z = xz_t.astype(acc_dt) + (h_prev @ RW).astype(acc_dt)
         zi, zf, zo, zg = (z[:, I * n_out:(I + 1) * n_out], z[:, F * n_out:(F + 1) * n_out],
                           z[:, O * n_out:(O + 1) * n_out], z[:, G * n_out:(G + 1) * n_out])
         if P is not None:
@@ -77,10 +92,10 @@ def _lstm_scan(params, x, h0, c0, gate_act, cell_act, peephole, mask=None, rever
         if P is not None:
             zo = zo + P[2 * n_out:] * c_new
         o_g = gate_fn(zo)
-        h_new = o_g * act_fn(c_new)
+        h_new = (o_g * act_fn(c_new)).astype(out_dt)
         if m_t is not None:
             m = m_t[:, None]
-            h_out = h_new * m
+            h_out = h_new * m.astype(out_dt)
             h_new = jnp.where(m > 0, h_new, h_prev)
             c_new = jnp.where(m > 0, c_new, c_prev)
         else:
@@ -90,8 +105,9 @@ def _lstm_scan(params, x, h0, c0, gate_act, cell_act, peephole, mask=None, rever
     xz_all = x @ W + b                # [b, t, 4n] single batched gemm
     xs = jnp.swapaxes(xz_all, 0, 1)   # [t, b, 4n]
     seq = (xs, jnp.swapaxes(mask, 0, 1)) if mask is not None else xs
-    (h_f, c_f), outs = lax.scan(step, (h0, c0), seq, reverse=reverse)
-    return jnp.swapaxes(outs, 0, 1), (h_f, c_f)
+    (h_f, c_f), outs = lax.scan(step, (h0.astype(out_dt), c0.astype(acc_dt)),
+                                seq, reverse=reverse)
+    return jnp.swapaxes(outs, 0, 1), (h_f.astype(acc_dt), c_f)
 
 
 class _BaseLSTMModule(BaseLayerModule):

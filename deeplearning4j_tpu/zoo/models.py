@@ -54,11 +54,15 @@ def mlp_mnist(seed=12345, hidden=512):
     return MultiLayerNetwork(conf)
 
 
-def char_rnn_lstm(vocab_size=80, hidden=256, layers=2, seed=12345, tbptt=50):
-    """GravesLSTM char-RNN (BASELINE config #3)."""
+def char_rnn_lstm(vocab_size=80, hidden=256, layers=2, seed=12345, tbptt=50,
+                  compute_dtype=None):
+    """GravesLSTM char-RNN (BASELINE config #3). compute_dtype="bfloat16"
+    runs the gemms on the MXU in bf16 while the LSTM carry and gate math
+    accumulate in f32 (nn/layers/recurrent.py:_lstm_scan)."""
     from ..nn.conf.configuration import BackpropType
     b = (NeuralNetConfiguration.builder()
          .seed(seed).updater(Adam(2e-3)).weight_init("xavier")
+         .compute_dtype(compute_dtype)
          .list())
     for _ in range(layers):
         b.layer(GravesLSTM(n_out=hidden, activation="tanh"))

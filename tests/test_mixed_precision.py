@@ -97,3 +97,31 @@ def test_score_stays_on_device_until_read():
     s = net.score_value                            # first read syncs...
     assert isinstance(s, float) and np.isfinite(s)
     assert isinstance(net._score_dev, float)       # ...and caches the float
+
+
+def test_bf16_lstm_keeps_f32_carry_numerics():
+    """Under compute_dtype="bfloat16" the LSTM gemms run bf16 but the
+    carried cell/hidden state accumulates in f32 (_lstm_scan) — a bf16
+    carry compounds rounding every timestep. Forward and several TBPTT
+    training steps must track the f32 model closely."""
+    import numpy as np
+    from deeplearning4j_tpu.datasets.dataset import DataSet
+    from deeplearning4j_tpu.zoo.models import char_rnn_lstm
+
+    rng = np.random.default_rng(0)
+    vocab, batch, seq = 40, 8, 60
+    ids = rng.integers(0, vocab, size=(batch, seq + 1))
+    x = np.eye(vocab, dtype=np.float32)[ids[:, :-1]]
+    y = np.eye(vocab, dtype=np.float32)[ids[:, 1:]]
+
+    a = char_rnn_lstm(vocab_size=vocab, hidden=64, layers=2, tbptt=30)
+    a.init()
+    b = char_rnn_lstm(vocab_size=vocab, hidden=64, layers=2, tbptt=30,
+                      compute_dtype="bfloat16")
+    b.init()
+    np.testing.assert_allclose(np.asarray(a.output(x)), np.asarray(b.output(x)),
+                               atol=0.05)
+    for net in (a, b):
+        for _ in range(8):
+            net.fit_batch(DataSet(x, y))
+    assert abs(float(a.score_value) - float(b.score_value)) < 0.3
