@@ -102,6 +102,33 @@ def _normalize_keras2_config(config):
     return {"class_name": "Sequential", "config": layers}
 
 
+# Keras-2 merge LAYERS (Keras 1 had one "Merge" with a mode string); mapped
+# onto the same graph vertices KerasModel.java:358 produces for Merge
+_K2_MERGE = {"Add": "add", "Subtract": "subtract", "Multiply": "product",
+             "Average": "average", "Maximum": "max", "Concatenate": None}
+
+
+def _normalize_keras2_functional(config):
+    """Keras-2 functional Model/Functional config -> the Keras-1 Model shape
+    `_import_functional` consumes: per-layer configs translated to the 1.x
+    vocabulary, names and inbound_nodes preserved (2.x may append a kwargs
+    dict to each inbound entry; the name stays element 0)."""
+    cfg = dict(config["config"])
+    out_layers = []
+    for lc in cfg["layers"]:
+        cn = lc["class_name"]
+        if cn == "InputLayer" or cn in _K2_MERGE:
+            nl = {"class_name": cn, "config": dict(lc["config"])}
+        else:
+            nl = _normalize_keras2_layer(lc)
+        nl["name"] = lc.get("name", nl["config"].get("name", cn))
+        nl["config"].setdefault("name", nl["name"])
+        nl["inbound_nodes"] = lc.get("inbound_nodes", [])
+        out_layers.append(nl)
+    cfg["layers"] = out_layers
+    return {"class_name": "Model", "config": cfg}
+
+
 def _normalize_keras2_weights(kl, weights):
     """Keras-2 weight names (kernel:0/bias:0/...) -> the Keras-1 names the
     assignment switch expects; Keras-2 LSTMs store FUSED kernels in gate
@@ -291,9 +318,39 @@ def _copy_weights_graph(net, weights_root, layer_names, keras_layers):
         grp = weights_root[kname]
         wnames = grp.attrs.get("weight_names", [])
         weights = {wn.split("/")[-1]: np.asarray(grp[wn].value) for wn in wnames}
+        weights = _normalize_keras2_weights(kl, weights)
         _assign_layer_weights(net.params[kname], net.states.get(kname, {}),
                               kl, weights)
     return net
+
+
+def _parse_training_loss(root):
+    """Loss from training_config: a string identifier, or — for
+    multi-output functional models — a {output_layer_name: loss} dict.
+    tf.keras serializes compiled loss OBJECTS as class dicts; those map
+    back to snake_case identifiers."""
+    import re as _re
+    if "training_config" not in root.attrs:
+        return None
+    loss = json.loads(root.attrs["training_config"]).get("loss")
+
+    def conv(lv):
+        if isinstance(lv, dict):
+            return _re.sub(r"(?<!^)(?=[A-Z])", "_",
+                           lv.get("class_name", "")).lower()
+        return lv
+
+    if isinstance(loss, dict) and "class_name" in loss:
+        return conv(loss)
+    if isinstance(loss, dict):
+        return {k: conv(v) for k, v in loss.items()}
+    if isinstance(loss, (list, tuple)):
+        # compile(loss=[...]) positional form: one entry per model output —
+        # single-output models unwrap; multi-output keeps positional order
+        # and _import_functional matches by output index
+        losses = [conv(lv) for lv in loss]
+        return losses[0] if len(losses) == 1 else losses
+    return loss
 
 
 class KerasModelImport:
@@ -310,17 +367,11 @@ class KerasModelImport:
             config = _normalize_keras2_config(config)
         keras_layers = [KerasLayer(lc["class_name"], lc["config"])
                         for lc in config["config"]]
-        training = None
-        if "training_config" in root.attrs:
-            training = json.loads(root.attrs["training_config"])
-        loss = training.get("loss") if training else None
-        if isinstance(loss, dict):
-            # tf.keras serializes compiled loss OBJECTS as dicts; map the
-            # class name back to the snake_case loss identifier
-            import re as _re
-            loss = _re.sub(r"(?<!^)(?=[A-Z])", "_",
-                           loss.get("class_name", "")).lower()
-
+        loss = _parse_training_loss(root)
+        if isinstance(loss, dict):   # multi-output forms on a Sequential:
+            loss = next(iter(loss.values()), None)
+        elif isinstance(loss, list):  # take the single real output's loss
+            loss = loss[0] if loss else None
         layers, input_type = _map_layers(keras_layers, loss=loss)
         from ..nn.conf.configuration import NeuralNetConfiguration
         from ..nn.updaters import Sgd
@@ -346,9 +397,7 @@ class KerasModelImport:
             return KerasModelImport.import_keras_sequential_model_and_weights(
                 path, enforce_training_config)
         if str(root.attrs.get("keras_version", "1")).startswith("2"):
-            raise ValueError(
-                "Keras 2.x functional models are not supported (Sequential "
-                "2.x and all 1.x layouts are); re-export as Sequential")
+            config = _normalize_keras2_functional(config)
         return KerasModelImport._import_functional(root, config)
 
     @staticmethod
@@ -363,11 +412,26 @@ class KerasModelImport:
         from ..nn.graph.graph import ComputationGraph
 
         cfg = config["config"]
+        if not cfg.get("layers") or not cfg.get("input_layers") \
+                or not cfg.get("output_layers"):
+            raise ValueError(
+                "functional config is missing layers/input_layers/"
+                "output_layers — not an importable Keras functional model")
+        loss_cfg = _parse_training_loss(root)
         klayers = [KerasLayer(lc["class_name"], lc["config"]) for lc in
                    cfg["layers"]]
         inbound = {}
         for lc, kl in zip(cfg["layers"], klayers):
             nodes = lc.get("inbound_nodes", [])
+            if len(nodes) > 1:
+                # a layer applied at several graph positions serializes one
+                # weight set with N inbound nodes; this importer keys
+                # vertices by layer name (one node each), so importing would
+                # silently compute the wrong graph — refuse instead
+                raise ValueError(
+                    f"layer {kl.name!r} is SHARED ({len(nodes)} call sites);"
+                    " shared-layer functional models are not supported —"
+                    " rebuild with distinct layer instances per call site")
             inbound[kl.name] = [n[0] for n in nodes[0]] if nodes else []
         input_names = [n[0] for n in cfg["input_layers"]]
         output_names = [n[0] for n in cfg["output_layers"]]
@@ -395,13 +459,50 @@ class KerasModelImport:
             if kl.class_name == "InputLayer":
                 continue
             srcs = inbound[kl.name]
-            if kl.class_name == "Merge":
-                mode = kl.config.get("mode", "concat")
-                vtx = MergeVertex() if mode == "concat" else \
-                    ElementWiseVertex(op="add" if mode == "sum" else mode)
+            if kl.class_name == "Merge" or kl.class_name in _K2_MERGE:
+                if kl.class_name == "Merge":    # Keras 1: one layer + mode
+                    mode = kl.config.get("mode", "concat")
+                    k1_ops = {"sum": "add", "mul": "product",
+                              "ave": "average", "max": "max"}
+                    if mode == "concat":
+                        vtx = MergeVertex()
+                    elif mode in k1_ops:
+                        vtx = ElementWiseVertex(op=k1_ops[mode])
+                    else:
+                        raise ValueError(
+                            f"Merge mode {mode!r} is not supported "
+                            "(concat/sum/mul/ave/max are)")
+                elif kl.class_name == "Concatenate":
+                    if kl.config.get("axis", -1) not in (-1, None):
+                        # a positive axis may or may not be the trailing
+                        # feature axis depending on tensor rank, which this
+                        # importer doesn't propagate — refusing beats a
+                        # silently transposed merge
+                        raise ValueError(
+                            "Concatenate with an explicit positive axis "
+                            f"(axis={kl.config['axis']}) cannot be verified "
+                            "as the trailing feature axis; re-save the model "
+                            "with axis=-1")
+                    vtx = MergeVertex()
+                else:                           # Keras 2: one class per op
+                    vtx = ElementWiseVertex(op=_K2_MERGE[kl.class_name])
                 gb.add_vertex(kl.name, vtx, *srcs)
                 continue
-            confs, _ = _map_layers([kl])
+            # a graph OUTPUT maps with its compiled loss so the imported
+            # model can keep training here (terminal Dense -> OutputLayer),
+            # mirroring the Sequential path; dict losses match by output
+            # name, list losses by output position
+            if isinstance(loss_cfg, dict):
+                lk = loss_cfg.get(kl.name)
+            elif isinstance(loss_cfg, list):
+                lk = (loss_cfg[output_names.index(kl.name)]
+                      if kl.name in output_names
+                      and output_names.index(kl.name) < len(loss_cfg)
+                      else None)
+            else:
+                lk = loss_cfg
+            confs, _ = _map_layers(
+                [kl], loss=lk if kl.name in output_names else None)
             if not confs:   # Flatten/pass-through
                 # splice: downstream consumers read from this vertex's input
                 for other in inbound.values():

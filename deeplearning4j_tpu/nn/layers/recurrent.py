@@ -66,7 +66,10 @@ def _lstm_scan(params, x, h0, c0, gate_act, cell_act, peephole, mask=None, rever
     W, RW, b = params["W"], params["RW"], params["b"]
     P = params.get("P")
     out_dt = x.dtype
-    acc_dt = jnp.float32 if out_dt == jnp.bfloat16 else out_dt
+    # any sub-32-bit float compute (bf16, and f16 with its 65504 max) gets
+    # the f32 accumulation treatment
+    acc_dt = (jnp.float32 if jnp.issubdtype(out_dt, jnp.floating)
+              and jnp.finfo(out_dt).bits < 32 else out_dt)
     if P is not None:
         P = P.astype(acc_dt)
 
