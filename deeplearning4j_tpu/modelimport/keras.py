@@ -440,8 +440,13 @@ class KerasModelImport:
               .graph_builder())
         gb.add_inputs(*input_names)
         input_types = []
-        for kl in klayers:
-            if kl.name not in input_names:
+        by_name = {kl.name: kl for kl in klayers}
+        # iterate in input_layers ORDER: Model(inputs=[b, a]) lists the
+        # layers in creation order but the inputs in call order, and the
+        # types must pair with add_inputs positionally
+        for in_name in input_names:
+            kl = by_name.get(in_name)
+            if kl is None:
                 continue
             shape = kl.config.get("batch_input_shape")
             dims = shape[1:] if shape else []
@@ -465,6 +470,12 @@ class KerasModelImport:
                     k1_ops = {"sum": "add", "mul": "product",
                               "ave": "average", "max": "max"}
                     if mode == "concat":
+                        if kl.config.get("concat_axis", -1) not in (-1, None):
+                            raise ValueError(
+                                "Merge(mode='concat') with an explicit "
+                                f"concat_axis={kl.config['concat_axis']} "
+                                "cannot be verified as the trailing feature "
+                                "axis; re-save with concat_axis=-1")
                         vtx = MergeVertex()
                     elif mode in k1_ops:
                         vtx = ElementWiseVertex(op=k1_ops[mode])
@@ -492,17 +503,16 @@ class KerasModelImport:
             # model can keep training here (terminal Dense -> OutputLayer),
             # mirroring the Sequential path; dict losses match by output
             # name, list losses by output position
-            if isinstance(loss_cfg, dict):
-                lk = loss_cfg.get(kl.name)
-            elif isinstance(loss_cfg, list):
-                lk = (loss_cfg[output_names.index(kl.name)]
-                      if kl.name in output_names
-                      and output_names.index(kl.name) < len(loss_cfg)
-                      else None)
-            else:
-                lk = loss_cfg
-            confs, _ = _map_layers(
-                [kl], loss=lk if kl.name in output_names else None)
+            lk = None
+            if kl.name in output_names:
+                if isinstance(loss_cfg, dict):
+                    lk = loss_cfg.get(kl.name)
+                elif isinstance(loss_cfg, list):
+                    pos = output_names.index(kl.name)
+                    lk = loss_cfg[pos] if pos < len(loss_cfg) else None
+                else:
+                    lk = loss_cfg
+            confs, _ = _map_layers([kl], loss=lk)
             if not confs:   # Flatten/pass-through
                 # splice: downstream consumers read from this vertex's input
                 for other in inbound.values():
