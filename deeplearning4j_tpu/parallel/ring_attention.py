@@ -43,6 +43,16 @@ def attention_reference(q, k, v, *, causal=False, scale=None, key_mask=None):
     return jnp.einsum("bhqk,bkhd->bqhd", p, v)
 
 
+def _causal_mask_fn(qpos):
+    """Scores mask: key positions after the query's global position get
+    NEG_INF (shared by the blockwise scan and the ring body)."""
+    def mask_fn(s, k_off):
+        kpos = k_off + jnp.arange(s.shape[-1])
+        bad = kpos[None, :] > qpos[:, None]               # Tq, Tb
+        return jnp.where(bad[None, None], NEG_INF, s)
+    return mask_fn
+
+
 def _block_update(carry, kv, q, scale, mask_fn=None):
     """Online-softmax accumulation of one K/V block into (o, m, l)."""
     o, m, l = carry
@@ -74,14 +84,7 @@ def blockwise_attention(q, k, v, *, block_size=256, causal=False, scale=None):
     vb = v.reshape(B, n_blocks, block_size, H, D).transpose(1, 0, 2, 3, 4)
     offs = jnp.arange(n_blocks) * block_size
 
-    mask_fn = None
-    if causal:
-        qpos = jnp.arange(Tq)
-
-        def mask_fn(s, k_off):
-            kpos = k_off + jnp.arange(block_size)
-            bad = kpos[None, :] > qpos[:, None]           # Tq, Tb
-            return jnp.where(bad[None, None], NEG_INF, s)
+    mask_fn = _causal_mask_fn(jnp.arange(Tq)) if causal else None
 
     o0 = jnp.zeros((B, H, Tq, D), q.dtype)
     m0 = jnp.full((B, H, Tq), NEG_INF, q.dtype)
@@ -110,26 +113,20 @@ def _ring_attention_local(q, k, v, *, causal, scale, axis_name):
     m = qt[..., 0] * 0.0 + NEG_INF                     # B,H,Tq
     l = qt[..., 0] * 0.0
     perm = [(i, (i + 1) % n) for i in range(n)]
+    mask_fn = _causal_mask_fn(my * Tq + jnp.arange(Tq)) if causal else None
 
     def body(r, state):
         o, m, l, kr, vr = state
-        # kr/vr originated on device (my - r) mod n
+        # kr/vr originated on device (my - r) mod n; the per-shard update is
+        # the SAME online-softmax step the single-device blockwise path
+        # scans with — a ring step is a blockwise step whose "block" is the
+        # visiting shard and whose key offset is that shard's global start
         src = (my - r) % n
-        s = jnp.einsum("bqhd,bkhd->bhqk", q, kr) * scale
-        if causal:
-            qpos = my * Tq + jnp.arange(Tq)
-            kpos = src * Tq + jnp.arange(Tq)
-            bad = kpos[None, :] > qpos[:, None]
-            s = jnp.where(bad[None, None], NEG_INF, s)
-        m_blk = jnp.max(s, axis=-1)
-        m_new = jnp.maximum(m, m_blk)
-        corr = jnp.exp(m - m_new)
-        p = jnp.exp(s - m_new[..., None])
-        l = l * corr + jnp.sum(p, axis=-1)
-        o = o * corr[..., None] + jnp.einsum("bhqk,bkhd->bhqd", p, vr)
+        (o, m, l), _ = _block_update((o, m, l), (kr, vr, src * Tq), q, scale,
+                                     mask_fn)
         kr = jax.lax.ppermute(kr, axis_name, perm)
         vr = jax.lax.ppermute(vr, axis_name, perm)
-        return o, m_new, l, kr, vr
+        return o, m, l, kr, vr
 
     o, m, l, _, _ = jax.lax.fori_loop(0, n, body, (o, m, l, k, v))
     out = o / jnp.maximum(l[..., None], 1e-30)
