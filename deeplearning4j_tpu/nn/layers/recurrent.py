@@ -211,21 +211,22 @@ class SelfAttentionLayerModule(BaseLayerModule):
         q = (x @ params["Wq"]).reshape(B, T, H, Dh)
         k = (x @ params["Wk"]).reshape(B, T, H, Dh)
         v = (x @ params["Wv"]).reshape(B, T, H, Dh)
-        if mask is not None:
-            out = attention_reference(q, k, v, causal=c.causal, key_mask=mask)
-        elif getattr(c, "use_pallas", False):
+        if mask is None and getattr(c, "use_pallas", False):
             from ...kernels import flash_attention
             # block_size tunes the QUERY tile only; the key tile keeps the
             # kernel's swept default (1024) — forcing both to block_size
             # starved the MXU (256x256 measured ~1.7x slower than 256x1024
-            # at T=4096 on a real v5e)
+            # at T=4096 on a real v5e). The Pallas kernel has no mask input;
+            # masked sequences take the blockwise path below, which matches
+            # attention_reference's key_mask semantics exactly
             out = flash_attention(q, k, v, causal=c.causal,
                                   block_q=int(c.block_size))
         elif T % min(int(c.block_size), T) == 0:
             out = blockwise_attention(q, k, v, block_size=int(c.block_size),
-                                      causal=c.causal)
+                                      causal=c.causal, key_mask=mask)
         else:
-            out = attention_reference(q, k, v, causal=c.causal)
+            out = attention_reference(q, k, v, causal=c.causal,
+                                      key_mask=mask)
         out = out.reshape(B, T, int(c.n_out)) @ params["Wo"] + params["b"]
         out = self.activation_fn()(out)
         if mask is not None:

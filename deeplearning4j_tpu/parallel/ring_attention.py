@@ -54,12 +54,21 @@ def _causal_mask_fn(qpos):
 
 
 def _block_update(carry, kv, q, scale, mask_fn=None):
-    """Online-softmax accumulation of one K/V block into (o, m, l)."""
+    """Online-softmax accumulation of one K/V block into (o, m, l).
+    kv = (kb, vb, k_off[, km]): km is an optional [B, Tb] KEY-validity mask
+    for this block. A fully-masked block is harmless: its scores are the
+    finite NEG_INF, so once any later block contributes a real max, the
+    exp(m - m_new) correction zeroes the bogus partials (and a row with NO
+    valid key anywhere degrades to the same uniform average the reference
+    softmax yields over all-NEG_INF scores)."""
     o, m, l = carry
-    kb, vb, k_off = kv
+    kb, vb, k_off = kv[:3]
+    km = kv[3] if len(kv) > 3 else None
     s = jnp.einsum("bqhd,bkhd->bhqk", q, kb) * scale      # B,H,Tq,Tb
     if mask_fn is not None:
         s = mask_fn(s, k_off)
+    if km is not None:
+        s = jnp.where(km[:, None, None, :] > 0, s, NEG_INF)
     m_blk = jnp.max(s, axis=-1)                           # B,H,Tq
     m_new = jnp.maximum(m, m_blk)
     corr = jnp.exp(m - m_new)
@@ -69,10 +78,13 @@ def _block_update(carry, kv, q, scale, mask_fn=None):
     return (o, m_new, l), None
 
 
-def blockwise_attention(q, k, v, *, block_size=256, causal=False, scale=None):
+def blockwise_attention(q, k, v, *, block_size=256, causal=False, scale=None,
+                        key_mask=None):
     """Single-device flash-style attention: scan over K/V blocks with online
     softmax — O(T_block) memory instead of O(T^2). Numerically identical to
-    attention_reference."""
+    attention_reference, including its key_mask ([batch, time] key validity)
+    semantics — masked sequences keep the memory-bounded path instead of
+    falling back to the materializing reference."""
     B, Tq, H, D = q.shape
     Tk = k.shape[1]
     block_size = min(block_size, Tk)
@@ -89,17 +101,26 @@ def blockwise_attention(q, k, v, *, block_size=256, causal=False, scale=None):
     o0 = jnp.zeros((B, H, Tq, D), q.dtype)
     m0 = jnp.full((B, H, Tq), NEG_INF, q.dtype)
     l0 = jnp.zeros((B, H, Tq), q.dtype)
+    if key_mask is not None:
+        # accept the same broadcastable shapes the reference does ((1, Tk)
+        # shared masks etc.) before carving into blocks
+        key_mask = jnp.broadcast_to(jnp.asarray(key_mask), (B, Tk))
+        kmb = key_mask.reshape(B, n_blocks, block_size).transpose(1, 0, 2)
+        blocks = (kb, vb, offs, kmb)
+    else:
+        blocks = (kb, vb, offs)
     (o, m, l), _ = jax.lax.scan(
         functools.partial(_block_update, q=q, scale=scale, mask_fn=mask_fn),
-        (o0, m0, l0), (kb, vb, offs))
+        (o0, m0, l0), blocks)
     out = o / jnp.maximum(l[..., None], 1e-30)
     return out.transpose(0, 2, 1, 3)                      # back to BTHD
 
 
-def _ring_attention_local(q, k, v, *, causal, scale, axis_name):
+def _ring_attention_local(q, k, v, km=None, *, causal, scale, axis_name):
     """Per-shard body under shard_map: each device owns a time-slice of
-    q/k/v; K/V blocks rotate around the ring (ppermute over ICI), queries
-    accumulate online-softmax partials."""
+    q/k/v (and of the optional key mask, which rotates with K/V); queries
+    accumulate online-softmax partials as K/V blocks move around the ring
+    (ppermute over ICI)."""
     B, Tq, H, D = q.shape
     n = jax.lax.psum(1, axis_name)
     my = jax.lax.axis_index(axis_name)
@@ -116,35 +137,48 @@ def _ring_attention_local(q, k, v, *, causal, scale, axis_name):
     mask_fn = _causal_mask_fn(my * Tq + jnp.arange(Tq)) if causal else None
 
     def body(r, state):
-        o, m, l, kr, vr = state
+        o, m, l, kr, vr, kmr = state
         # kr/vr originated on device (my - r) mod n; the per-shard update is
         # the SAME online-softmax step the single-device blockwise path
         # scans with — a ring step is a blockwise step whose "block" is the
         # visiting shard and whose key offset is that shard's global start
+        # (kmr is None — a static empty pytree node — on the unmasked path,
+        # which therefore pays no mask select and no extra ppermute)
         src = (my - r) % n
-        (o, m, l), _ = _block_update((o, m, l), (kr, vr, src * Tq), q, scale,
-                                     mask_fn)
+        blk = (kr, vr, src * Tq) if kmr is None else (kr, vr, src * Tq, kmr)
+        (o, m, l), _ = _block_update((o, m, l), blk, q, scale, mask_fn)
         kr = jax.lax.ppermute(kr, axis_name, perm)
         vr = jax.lax.ppermute(vr, axis_name, perm)
-        return o, m, l, kr, vr
+        if kmr is not None:
+            kmr = jax.lax.ppermute(kmr, axis_name, perm)
+        return o, m, l, kr, vr, kmr
 
-    o, m, l, _, _ = jax.lax.fori_loop(0, n, body, (o, m, l, k, v))
+    o, m, l, _, _, _ = jax.lax.fori_loop(0, n, body, (o, m, l, k, v, km))
     out = o / jnp.maximum(l[..., None], 1e-30)
     return out.transpose(0, 2, 1, 3)
 
 
 def ring_attention(q, k, v, mesh, *, causal=False, scale=None,
-                   axis_name=SEQ_AXIS):
+                   axis_name=SEQ_AXIS, key_mask=None):
     """Sequence-parallel attention over `mesh`'s `axis_name` ring: time is
     sharded across devices; peak memory per device is O(T/n) and the K/V
-    transfer rides the ICI ring concurrently with compute."""
+    transfer rides the ICI ring concurrently with compute. key_mask:
+    optional [batch, time] key validity, sharded and rotated with K/V."""
     spec = P(None, axis_name, None, None)
     sh = NamedSharding(mesh, spec)
-    fn = shard_map(
-        functools.partial(_ring_attention_local, causal=causal, scale=scale,
-                          axis_name=axis_name),
-        mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec)
     q = jax.device_put(q, sh)
     k = jax.device_put(k, sh)
     v = jax.device_put(v, sh)
-    return fn(q, k, v)
+    body = functools.partial(_ring_attention_local, causal=causal,
+                             scale=scale, axis_name=axis_name)
+    if key_mask is None:   # unmasked path: no mask traffic on the ring
+        fn = shard_map(body, mesh=mesh, in_specs=(spec, spec, spec),
+                       out_specs=spec)
+        return fn(q, k, v)
+    mspec = P(None, axis_name)
+    key_mask = jnp.broadcast_to(jnp.asarray(key_mask, q.dtype),
+                                q.shape[:2])
+    key_mask = jax.device_put(key_mask, NamedSharding(mesh, mspec))
+    fn = shard_map(body, mesh=mesh, in_specs=(spec, spec, spec, mspec),
+                   out_specs=spec)
+    return fn(q, k, v, key_mask)

@@ -49,6 +49,41 @@ def test_ring_attention_matches_reference(causal):
                                rtol=2e-4, atol=2e-5)
 
 
+@pytest.mark.parametrize("causal", [False, True])
+def test_blockwise_attention_key_mask_matches_reference(causal):
+    """key_mask on the memory-bounded path == the reference oracle,
+    including blocks that are FULLY masked for some rows (the online
+    softmax's exp(m - m_new) correction must zero their bogus partials)."""
+    rng = np.random.default_rng(7)
+    q, k, v = _qkv(rng)
+    mask = (rng.random((2, 32)) > 0.4).astype(np.float32)
+    mask[0, :8] = 0.0      # an entirely-masked leading block (block_size=8)
+    mask[:, -1] = 1.0      # every row keeps at least one valid key
+    mask = jnp.asarray(mask)
+    full = attention_reference(q, k, v, causal=causal, key_mask=mask)
+    blk = blockwise_attention(q, k, v, block_size=8, causal=causal,
+                              key_mask=mask)
+    np.testing.assert_allclose(np.asarray(full), np.asarray(blk),
+                               rtol=2e-4, atol=2e-5)
+
+
+@pytest.mark.parametrize("causal", [False, True])
+def test_ring_attention_key_mask_matches_reference(causal):
+    """The key mask shards over the seq axis and rotates around the ring
+    with K/V; results must equal full masked attention."""
+    mesh = make_mesh(n_data=1, n_seq=8)
+    rng = np.random.default_rng(8)
+    q, k, v = _qkv(rng, T=64)
+    mask = (rng.random((2, 64)) > 0.4).astype(np.float32)
+    mask[1, 8:16] = 0.0    # one device's whole shard masked for a row
+    mask[:, 0] = 1.0
+    mask = jnp.asarray(mask)
+    full = attention_reference(q, k, v, causal=causal, key_mask=mask)
+    ring = ring_attention(q, k, v, mesh, causal=causal, key_mask=mask)
+    np.testing.assert_allclose(np.asarray(full), np.asarray(ring),
+                               rtol=2e-4, atol=2e-5)
+
+
 def test_self_attention_layer_forward_and_gradcheck():
     from deeplearning4j_tpu.gradientcheck.gradient_check_util import check_gradients
     rng = np.random.default_rng(2)
