@@ -16,6 +16,24 @@ contraction are stored lane-broadcast ([BH, T, 128] f32, 512 B/row) — the
 layout Mosaic handles natively for row-vector operands (a plain [BH, T]
 residual would need a lane→sublane transpose inside the kernel).
 
+Masking: a key-validity mask ([batch, Tk], shared across heads via the
+block index map — no H× replication in HBM) folds into the score tile at
+the same place the causal iota mask sits, in the forward AND both backward
+kernels, so variable-length/packed batches keep the fast path (reference
+mask contract: nn/api/Layer.java:309 feedForwardMaskArray /
+util/MaskedReductionUtil.java). Masked scores are the finite NEG_INF, so a
+row with no valid key degrades to the reference softmax's uniform average
+(under `causal` that uniform spans only the non-skipped ≤-diagonal blocks —
+a degenerate case no real padded batch hits: padding leaves every query at
+least one causally-visible valid key).
+
+Ring hookup: `flash_attention_lse` additionally returns the per-row LSE and
+takes dynamic global q/k position offsets (SMEM scalars) for the causal
+mask, which is exactly what a ring-attention step needs to run this kernel
+on each visiting K/V shard (parallel/ring_attention.py merges the per-shard
+(out, lse) partials by log-sum-exp). The LSE cotangent folds into the
+backward for free: ds = p·(dp − Δ) with Δ = rowsum(dO·O) − g_lse.
+
 Falls back transparently (see `flash_attention`) when shapes don't tile or
 Pallas is unavailable, so callers can use it unconditionally.
 """
@@ -23,6 +41,7 @@ from __future__ import annotations
 
 import functools
 
+import numpy as np
 import jax
 import jax.numpy as jnp
 
@@ -32,15 +51,42 @@ NEG_INF = -1e30
 LANES = 128  # lse/delta residuals are stored broadcast over one lane tile
 
 
-def _flash_kernel(q_ref, k_ref, v_ref, o_ref, *rest, scale, causal, block_q,
-                  block_k, nk, need_lse):
-    # rest = (lse_ref?, acc_ref, m_ref, l_ref) — lse output only exists on
-    # the vjp-forward path; inference skips the HBM write entirely
-    lse_ref = rest[0] if need_lse else None
-    acc_ref, m_ref, l_ref = rest[-3:]
+def _mask_fold(s, km_ref):
+    """Fold the [1, block_k] key-validity row (the BlockSpec index map
+    already selected this key block) into the score tile — broadcasts over
+    the q sublanes."""
+    km = km_ref[0]                               # [1, block_k]
+    return jnp.where(km > 0, s, NEG_INF)
+
+
+def _causal_fold(s, qi, ki, q_off, k_off, block_q, block_k):
+    qpos = q_off + qi * block_q + jax.lax.broadcasted_iota(jnp.int32, s.shape, 0)
+    kpos = k_off + ki * block_k + jax.lax.broadcasted_iota(jnp.int32, s.shape, 1)
+    return jnp.where(kpos > qpos, NEG_INF, s)
+
+
+def _causal_keep(qi, ki, q_off, k_off, block_q, block_k):
+    """Whether this (q block, k block) pair has any unmasked causal entry:
+    skip blocks entirely above the diagonal (~half the grid) — they are fully
+    masked and would pay both matmuls for nothing. With dynamic ring offsets
+    this is a runtime predicate on the same inequality."""
+    return k_off + ki * block_k <= q_off + (qi + 1) * block_q - 1
+
+
+def _flash_kernel(*refs, scale, causal, block_q, block_k, nk, need_lse,
+                  has_mask, has_offs):
     from jax.experimental import pallas as pl
+    it = iter(refs)
+    q_ref, k_ref, v_ref = next(it), next(it), next(it)
+    offs_ref = next(it) if has_offs else None
+    km_ref = next(it) if has_mask else None
+    o_ref = next(it)
+    lse_ref = next(it) if need_lse else None
+    acc_ref, m_ref, l_ref = next(it), next(it), next(it)
     ki = pl.program_id(2)
     qi = pl.program_id(1)
+    q_off = offs_ref[0] if has_offs else 0
+    k_off = offs_ref[1] if has_offs else 0
 
     @pl.when(ki == 0)
     def _init():
@@ -55,9 +101,9 @@ def _flash_kernel(q_ref, k_ref, v_ref, o_ref, *rest, scale, causal, block_q,
         s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
                                 preferred_element_type=jnp.float32) * scale
         if causal:
-            qpos = qi * block_q + jax.lax.broadcasted_iota(jnp.int32, s.shape, 0)
-            kpos = ki * block_k + jax.lax.broadcasted_iota(jnp.int32, s.shape, 1)
-            s = jnp.where(kpos > qpos, NEG_INF, s)
+            s = _causal_fold(s, qi, ki, q_off, k_off, block_q, block_k)
+        if has_mask:
+            s = _mask_fold(s, km_ref)
 
         m_prev = m_ref[:, :1]                    # [bq, 1]
         l_prev = l_ref[:, :1]
@@ -72,9 +118,8 @@ def _flash_kernel(q_ref, k_ref, v_ref, o_ref, *rest, scale, causal, block_q,
         l_ref[...] = jnp.broadcast_to(l_new, l_ref.shape)
 
     if causal:
-        # skip k-blocks entirely above the diagonal (~half the grid): they
-        # are fully masked and would pay both matmuls for nothing
-        pl.when(ki * block_k <= (qi + 1) * block_q - 1)(_accumulate)
+        pl.when(_causal_keep(qi, ki, q_off, k_off, block_q, block_k))(
+            _accumulate)
     else:
         _accumulate()
 
@@ -95,10 +140,31 @@ def _fold_heads(x):
     return jnp.swapaxes(x, 1, 2).reshape(B * H, T, D)
 
 
-def _flash_forward(q, k, v, scale, causal, block_q, block_k, interpret,
-                   need_lse=False):
+def _mask_spec(H, block_k, kdim):
+    """BlockSpec for the [B, 1, Tk] key mask: heads share one batch row via
+    the b // H index map — the mask never replicates H× in HBM. `kdim` names
+    which grid axis walks the key blocks (2 on forward/dq grids, 1 on the
+    dk/dv grid)."""
+    from jax.experimental import pallas as pl
+
+    def index(b, i, j, H=H):
+        kb = (i, j)[kdim - 1]
+        return (b // H, 0, kb)
+    return pl.BlockSpec((1, 1, block_k), index)
+
+
+def _offs_smem_spec():
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+    return pl.BlockSpec(memory_space=pltpu.SMEM)
+
+
+def _flash_forward(q, k, v, km, offs, scale, causal, block_q, block_k,
+                   interpret, need_lse=False):
     """Returns (out [B,Tq,H,D], lse [BH,Tq,LANES] f32 | None).
 
+    km: optional [B, 1, Tk] f32 key-validity mask; offs: optional int32 [2]
+    (global q, k position offsets for the causal mask — the ring path).
     The LSE residual is emitted (written to HBM) only when `need_lse` —
     inference-only calls skip that extra output-sized write."""
     from jax.experimental import pallas as pl
@@ -111,19 +177,28 @@ def _flash_forward(q, k, v, scale, causal, block_q, block_k, interpret,
     nk = Tk // block_k
     kernel = functools.partial(_flash_kernel, scale=scale, causal=causal,
                                block_q=block_q, block_k=block_k, nk=nk,
-                               need_lse=need_lse)
+                               need_lse=need_lse, has_mask=km is not None,
+                               has_offs=offs is not None)
     o_spec = pl.BlockSpec((1, block_q, D), lambda b, qi, ki: (b, qi, 0))
     o_shape = jax.ShapeDtypeStruct((B * H, Tq, D), q.dtype)
     lse_spec = pl.BlockSpec((1, block_q, LANES), lambda b, qi, ki: (b, qi, 0))
     lse_shape = jax.ShapeDtypeStruct((B * H, Tq, LANES), jnp.float32)
+    in_specs = [
+        pl.BlockSpec((1, block_q, D), lambda b, qi, ki: (b, qi, 0)),
+        pl.BlockSpec((1, block_k, D), lambda b, qi, ki: (b, ki, 0)),
+        pl.BlockSpec((1, block_k, D), lambda b, qi, ki: (b, ki, 0)),
+    ]
+    args = [qf, kf, vf]
+    if offs is not None:
+        in_specs.append(_offs_smem_spec())
+        args.append(offs)
+    if km is not None:
+        in_specs.append(_mask_spec(H, block_k, kdim=2))
+        args.append(km)
     res = pl.pallas_call(
         kernel,
         grid=(B * H, nq, nk),
-        in_specs=[
-            pl.BlockSpec((1, block_q, D), lambda b, qi, ki: (b, qi, 0)),
-            pl.BlockSpec((1, block_k, D), lambda b, qi, ki: (b, ki, 0)),
-            pl.BlockSpec((1, block_k, D), lambda b, qi, ki: (b, ki, 0)),
-        ],
+        in_specs=in_specs,
         out_specs=[o_spec, lse_spec] if need_lse else [o_spec],
         out_shape=[o_shape, lse_shape] if need_lse else [o_shape],
         scratch_shapes=[
@@ -134,17 +209,26 @@ def _flash_forward(q, k, v, scale, causal, block_q, block_k, interpret,
         compiler_params=pltpu.CompilerParams(
             dimension_semantics=("parallel", "parallel", "arbitrary")),
         interpret=interpret,
-    )(qf, kf, vf)
+    )(*args)
     out = res[0]
     lse = res[1] if need_lse else None
     return jnp.swapaxes(out.reshape(B, H, Tq, D), 1, 2), lse
 
 
-def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
-                   dq_acc, *, scale, causal, block_q, block_k, nk):
+def _bwd_dq_kernel(*refs, scale, causal, block_q, block_k, nk, has_mask,
+                   has_offs):
     from jax.experimental import pallas as pl
+    it = iter(refs)
+    q_ref, k_ref, v_ref, do_ref = next(it), next(it), next(it), next(it)
+    lse_ref, delta_ref = next(it), next(it)
+    offs_ref = next(it) if has_offs else None
+    km_ref = next(it) if has_mask else None
+    dq_ref = next(it)
+    dq_acc = next(it)
     ki = pl.program_id(2)
     qi = pl.program_id(1)
+    q_off = offs_ref[0] if has_offs else 0
+    k_off = offs_ref[1] if has_offs else 0
 
     @pl.when(ki == 0)
     def _init():
@@ -160,9 +244,9 @@ def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
         s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
                                 preferred_element_type=jnp.float32) * scale
         if causal:
-            qpos = qi * block_q + jax.lax.broadcasted_iota(jnp.int32, s.shape, 0)
-            kpos = ki * block_k + jax.lax.broadcasted_iota(jnp.int32, s.shape, 1)
-            s = jnp.where(kpos > qpos, NEG_INF, s)
+            s = _causal_fold(s, qi, ki, q_off, k_off, block_q, block_k)
+        if has_mask:
+            s = _mask_fold(s, km_ref)
         p = jnp.exp(s - lse)                      # [bq, bk]
         dp = jax.lax.dot_general(do, v, (((1,), (1,)), ((), ())),
                                  preferred_element_type=jnp.float32)
@@ -171,7 +255,8 @@ def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
             ds, k, (((1,), (0,)), ((), ())), preferred_element_type=jnp.float32)
 
     if causal:
-        pl.when(ki * block_k <= (qi + 1) * block_q - 1)(_accumulate)
+        pl.when(_causal_keep(qi, ki, q_off, k_off, block_q, block_k))(
+            _accumulate)
     else:
         _accumulate()
 
@@ -180,12 +265,20 @@ def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
         dq_ref[0, ...] = dq_acc[...].astype(dq_ref.dtype)
 
 
-def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
-                    dk_ref, dv_ref, dk_acc, dv_acc, *, scale, causal,
-                    block_q, block_k, nq):
+def _bwd_dkv_kernel(*refs, scale, causal, block_q, block_k, nq, has_mask,
+                    has_offs):
     from jax.experimental import pallas as pl
+    it = iter(refs)
+    q_ref, k_ref, v_ref, do_ref = next(it), next(it), next(it), next(it)
+    lse_ref, delta_ref = next(it), next(it)
+    offs_ref = next(it) if has_offs else None
+    km_ref = next(it) if has_mask else None
+    dk_ref, dv_ref = next(it), next(it)
+    dk_acc, dv_acc = next(it), next(it)
     ki = pl.program_id(1)
     qi = pl.program_id(2)
+    q_off = offs_ref[0] if has_offs else 0
+    k_off = offs_ref[1] if has_offs else 0
 
     @pl.when(qi == 0)
     def _init():
@@ -202,9 +295,9 @@ def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
         s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
                                 preferred_element_type=jnp.float32) * scale
         if causal:
-            qpos = qi * block_q + jax.lax.broadcasted_iota(jnp.int32, s.shape, 0)
-            kpos = ki * block_k + jax.lax.broadcasted_iota(jnp.int32, s.shape, 1)
-            s = jnp.where(kpos > qpos, NEG_INF, s)
+            s = _causal_fold(s, qi, ki, q_off, k_off, block_q, block_k)
+        if has_mask:
+            s = _mask_fold(s, km_ref)
         p = jnp.exp(s - lse)                      # [bq, bk]
         # dV += Pᵀ·dO ; dK += dSᵀ·Q  (contract over the q rows)
         dv_acc[...] += jax.lax.dot_general(
@@ -218,7 +311,8 @@ def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
     if causal:
         # skip q blocks strictly above the diagonal: every row there masks
         # this whole k block ((qi+1)*bq - 1 < ki*bk)
-        pl.when((qi + 1) * block_q - 1 >= ki * block_k)(_accumulate)
+        pl.when(_causal_keep(qi, ki, q_off, k_off, block_q, block_k))(
+            _accumulate)
     else:
         _accumulate()
 
@@ -228,8 +322,8 @@ def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
         dv_ref[0, ...] = dv_acc[...].astype(dv_ref.dtype)
 
 
-def _flash_backward(q, k, v, out, lse, g, scale, causal, block_q, block_k,
-                    interpret):
+def _flash_backward(q, k, v, out, lse, g, km, offs, scale, causal, block_q,
+                    block_k, interpret, g_lse=None):
     from jax.experimental import pallas as pl
     from jax.experimental.pallas import tpu as pltpu
     B, Tq, H, D = q.shape
@@ -237,15 +331,34 @@ def _flash_backward(q, k, v, out, lse, g, scale, causal, block_q, block_k,
     nq, nk = Tq // block_q, Tk // block_k
     qf, kf, vf = _fold_heads(q), _fold_heads(k), _fold_heads(v)
     dof = _fold_heads(g)
-    # delta_i = Σ_d dO_id · O_id, lane-broadcast like lse (see module doc)
+    # delta_i = Σ_d dO_id · O_id (− the LSE cotangent when the caller uses
+    # the (out, lse) primal pair: ds = p·(dp − delta + g_lse) folds into the
+    # same kernel as a delta shift), lane-broadcast like lse (module doc)
     delta = jnp.sum(dof.astype(jnp.float32) * _fold_heads(out).astype(jnp.float32),
                     axis=-1)
+    if g_lse is not None:
+        delta = delta - g_lse.reshape(B * H, Tq)
     delta = jnp.broadcast_to(delta[..., None], (B * H, Tq, LANES))
+    lse = jnp.broadcast_to(lse[..., None], (B * H, Tq, LANES))
+
+    extra_args = []
+    dq_extra_specs = []
+    dkv_extra_specs = []
+    if offs is not None:
+        extra_args.append(offs)
+        dq_extra_specs.append(_offs_smem_spec())
+        dkv_extra_specs.append(_offs_smem_spec())
+    if km is not None:
+        extra_args.append(km)
+        dq_extra_specs.append(_mask_spec(H, block_k, kdim=2))
+        dkv_extra_specs.append(_mask_spec(H, block_k, kdim=1))
+    has_mask, has_offs = km is not None, offs is not None
 
     lane_spec = pl.BlockSpec((1, block_q, LANES), lambda b, qi, ki: (b, qi, 0))
     dq = pl.pallas_call(
         functools.partial(_bwd_dq_kernel, scale=scale, causal=causal,
-                          block_q=block_q, block_k=block_k, nk=nk),
+                          block_q=block_q, block_k=block_k, nk=nk,
+                          has_mask=has_mask, has_offs=has_offs),
         grid=(B * H, nq, nk),
         in_specs=[
             pl.BlockSpec((1, block_q, D), lambda b, qi, ki: (b, qi, 0)),
@@ -254,19 +367,20 @@ def _flash_backward(q, k, v, out, lse, g, scale, causal, block_q, block_k,
             pl.BlockSpec((1, block_q, D), lambda b, qi, ki: (b, qi, 0)),
             lane_spec,
             lane_spec,
-        ],
+        ] + dq_extra_specs,
         out_specs=pl.BlockSpec((1, block_q, D), lambda b, qi, ki: (b, qi, 0)),
         out_shape=jax.ShapeDtypeStruct((B * H, Tq, D), q.dtype),
         scratch_shapes=[pltpu.VMEM((block_q, D), jnp.float32)],
         compiler_params=pltpu.CompilerParams(
             dimension_semantics=("parallel", "parallel", "arbitrary")),
         interpret=interpret,
-    )(qf, kf, vf, dof, lse, delta)
+    )(qf, kf, vf, dof, lse, delta, *extra_args)
 
     qlane = pl.BlockSpec((1, block_q, LANES), lambda b, ki, qi: (b, qi, 0))
     dk, dv = pl.pallas_call(
         functools.partial(_bwd_dkv_kernel, scale=scale, causal=causal,
-                          block_q=block_q, block_k=block_k, nq=nq),
+                          block_q=block_q, block_k=block_k, nq=nq,
+                          has_mask=has_mask, has_offs=has_offs),
         grid=(B * H, nk, nq),
         in_specs=[
             pl.BlockSpec((1, block_q, D), lambda b, ki, qi: (b, qi, 0)),
@@ -275,7 +389,7 @@ def _flash_backward(q, k, v, out, lse, g, scale, causal, block_q, block_k,
             pl.BlockSpec((1, block_q, D), lambda b, ki, qi: (b, qi, 0)),
             qlane,
             qlane,
-        ],
+        ] + dkv_extra_specs,
         out_specs=[
             pl.BlockSpec((1, block_k, D), lambda b, ki, qi: (b, ki, 0)),
             pl.BlockSpec((1, block_k, D), lambda b, ki, qi: (b, ki, 0)),
@@ -289,31 +403,73 @@ def _flash_backward(q, k, v, out, lse, g, scale, causal, block_q, block_k,
         compiler_params=pltpu.CompilerParams(
             dimension_semantics=("parallel", "parallel", "arbitrary")),
         interpret=interpret,
-    )(qf, kf, vf, dof, lse, delta)
+    )(qf, kf, vf, dof, lse, delta, *extra_args)
 
     unfold = lambda x, T: jnp.swapaxes(x.reshape(B, H, T, D), 1, 2)
     return unfold(dq, Tq), unfold(dk, Tk), unfold(dv, Tk)
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7))
-def _flash(q, k, v, scale, causal, block_q, block_k, interpret):
-    return _flash_forward(q, k, v, scale, causal, block_q, block_k,
+def _zero_cotangents(km, offs):
+    """Cotangents for the non-differentiable mask/offset operands: float0
+    for the int32 offsets (JAX's required cotangent type for integer
+    primals), zeros for the float mask."""
+    km_ct = None if km is None else jnp.zeros_like(km)
+    offs_ct = None if offs is None else np.zeros(offs.shape, jax.dtypes.float0)
+    return km_ct, offs_ct
+
+
+# --------------------------------------------------------------------- plain
+@functools.partial(jax.custom_vjp, nondiff_argnums=(5, 6, 7, 8, 9))
+def _flash(q, k, v, km, offs, scale, causal, block_q, block_k, interpret):
+    return _flash_forward(q, k, v, km, offs, scale, causal, block_q, block_k,
                           interpret)[0]
 
 
-def _flash_fwd(q, k, v, scale, causal, block_q, block_k, interpret):
-    out, lse = _flash_forward(q, k, v, scale, causal, block_q, block_k,
-                              interpret, need_lse=True)
-    return out, (q, k, v, out, lse)
+def _flash_fwd(q, k, v, km, offs, scale, causal, block_q, block_k, interpret):
+    out, lse = _flash_forward(q, k, v, km, offs, scale, causal, block_q,
+                              block_k, interpret, need_lse=True)
+    return out, (q, k, v, km, offs, out, lse[..., 0])
 
 
 def _flash_bwd(scale, causal, block_q, block_k, interpret, res, g):
-    q, k, v, out, lse = res
-    return _flash_backward(q, k, v, out, lse, g, scale, causal, block_q,
-                           block_k, interpret)
+    q, k, v, km, offs, out, lse = res
+    dq, dk, dv = _flash_backward(q, k, v, out, lse, g, km, offs, scale,
+                                 causal, block_q, block_k, interpret)
+    km_ct, offs_ct = _zero_cotangents(km, offs)
+    return dq, dk, dv, km_ct, offs_ct
 
 
 _flash.defvjp(_flash_fwd, _flash_bwd)
+
+
+# ---------------------------------------------------------------- (out, lse)
+@functools.partial(jax.custom_vjp, nondiff_argnums=(5, 6, 7, 8, 9))
+def _flash_lse(q, k, v, km, offs, scale, causal, block_q, block_k, interpret):
+    out, lse = _flash_forward(q, k, v, km, offs, scale, causal, block_q,
+                              block_k, interpret, need_lse=True)
+    B, Tq, H, _ = q.shape
+    return out, lse[..., 0].reshape(B, H, Tq)
+
+
+def _flash_lse_fwd(q, k, v, km, offs, scale, causal, block_q, block_k,
+                   interpret):
+    out, lse = _flash_lse(q, k, v, km, offs, scale, causal, block_q, block_k,
+                          interpret)
+    B, Tq, H, _ = q.shape
+    return (out, lse), (q, k, v, km, offs, out, lse.reshape(B * H, Tq))
+
+
+def _flash_lse_bwd(scale, causal, block_q, block_k, interpret, res, g):
+    q, k, v, km, offs, out, lse = res
+    g_out, g_lse = g
+    dq, dk, dv = _flash_backward(q, k, v, out, lse, g_out, km, offs, scale,
+                                 causal, block_q, block_k, interpret,
+                                 g_lse=g_lse)
+    km_ct, offs_ct = _zero_cotangents(km, offs)
+    return dq, dk, dv, km_ct, offs_ct
+
+
+_flash_lse.defvjp(_flash_lse_fwd, _flash_lse_bwd)
 
 
 def _fit_block(T, target, align):
@@ -325,8 +481,28 @@ def _fit_block(T, target, align):
     return None
 
 
-def flash_attention(q, k, v, *, causal=False, scale=None, block_q=256,
-                    block_k=1024, interpret=None):
+def _plan(Tq, Tk, D, block_q, block_k, interpret):
+    """(block_q, block_k) the kernel can run with, or None => fall back.
+    Mosaic requires tile-aligned blocks when compiling (sublane multiple of
+    8, lane multiple of 128 on the [block_q, block_k] score tile); interpret
+    mode (CPU tests) has no such constraint so small blocks stay allowed."""
+    q_align, k_align = (1, 1) if interpret else (8, 128)
+    bq = _fit_block(Tq, min(block_q, Tq), q_align)
+    bk = _fit_block(Tk, min(block_k, Tk), k_align)
+    if bq is None or bk is None or D % 8:
+        return None
+    return bq, bk
+
+
+def _prep_mask(key_mask, B, Tk):
+    """[B, 1, Tk] f32 kernel mask from any reference-style broadcastable
+    key mask ((Tk,), (1, Tk), (B, Tk))."""
+    km = jnp.broadcast_to(jnp.asarray(key_mask), (B, Tk))
+    return km.astype(jnp.float32)[:, None, :]
+
+
+def flash_attention(q, k, v, *, causal=False, scale=None, key_mask=None,
+                    block_q=256, block_k=1024, interpret=None):
     """Pallas flash attention on [batch, time, heads, head_dim] tensors.
 
     Default blocks (256 query x 1024 key) were swept on a real v5e: they run
@@ -335,7 +511,12 @@ def flash_attention(q, k, v, *, causal=False, scale=None, block_q=256,
     tiling was ~2x slower than the reference because each kernel invocation
     did too little MXU work per grid step.
 
-    Falls back to the pure-JAX blockwise path when the sequence doesn't tile
+    key_mask: optional [batch, Tk] (or broadcastable) key-position validity —
+    same semantics as attention_reference/blockwise_attention, folded into
+    the score tiles of the forward and both backward kernels (packed/ragged
+    batches keep the fast path).
+
+    Falls back to the pure-JAX reference path when the sequence doesn't tile
     into the requested blocks or Pallas can't run (shape/platform); callers
     may use it unconditionally."""
     B, Tq, H, D = q.shape
@@ -344,19 +525,59 @@ def flash_attention(q, k, v, *, causal=False, scale=None, block_q=256,
         scale = float(1.0 / (D ** 0.5))
     if interpret is None:
         interpret = jax.default_backend() != "tpu"
-    # divisibility alone isn't enough when compiling: Mosaic requires
-    # tile-aligned blocks (sublane dim multiple of 8, lane dim multiple of
-    # 128 — the score tile is [block_q, block_k]); e.g. Tq=100 divides into
-    # one 100-row block but would be rejected at TPU compile time. Interpret
-    # mode (CPU tests) has no such constraint, so small blocks stay allowed
-    # there to keep kernel-logic tests cheap. When the requested block
-    # doesn't tile the sequence, shrink to the largest aligned divisor
-    # before giving up — T=1920 runs flash at 128x128 rather than paying
-    # the [T,T] materialization of the reference path.
-    q_align, k_align = (1, 1) if interpret else (8, 128)
-    block_q = _fit_block(Tq, min(block_q, Tq), q_align)
-    block_k = _fit_block(Tk, min(block_k, Tk), k_align)
-    if block_q is None or block_k is None or D % 8:
-        from ..parallel.ring_attention import attention_reference
-        return attention_reference(q, k, v, causal=causal, scale=scale)
-    return _flash(q, k, v, scale, causal, block_q, block_k, interpret)
+    plan = _plan(Tq, Tk, D, block_q, block_k, interpret)
+    if plan is None:
+        # prefer the O(T_block)-memory blockwise scan over the materializing
+        # reference whenever a sane key-block divisor exists — long ragged
+        # batches are exactly where the [Tq, Tk] score temp hurts
+        from ..parallel.ring_attention import (attention_reference,
+                                               blockwise_attention)
+        blk = _fit_block(Tk, min(block_k, Tk), 1)
+        if blk is not None and blk >= 8:
+            return blockwise_attention(q, k, v, block_size=blk, causal=causal,
+                                       scale=scale, key_mask=key_mask)
+        return attention_reference(q, k, v, causal=causal, scale=scale,
+                                   key_mask=key_mask)
+    km = None if key_mask is None else _prep_mask(key_mask, B, Tk)
+    return _flash(q, k, v, km, None, scale, causal, plan[0], plan[1],
+                  interpret)
+
+
+def flash_attention_lse(q, k, v, *, causal=False, scale=None, key_mask=None,
+                        q_offset=None, k_offset=None, block_q=256,
+                        block_k=1024, interpret=None):
+    """Flash attention that ALSO returns the per-row log-sum-exp
+    ([batch, heads, Tq] f32) so partial results over disjoint key shards can
+    be merged exactly (parallel/ring_attention.py's per-ring-step update).
+
+    q_offset/k_offset: dynamic global positions of q[0] / k[0] for the
+    causal mask (traced scalars are fine — they ride to the kernel in SMEM).
+    No shape fallback here: callers must check `can_flash(...)` first (the
+    ring keeps its einsum block update for non-tiling shapes)."""
+    B, Tq, H, D = q.shape
+    Tk = k.shape[1]
+    if scale is None:
+        scale = float(1.0 / (D ** 0.5))
+    if interpret is None:
+        interpret = jax.default_backend() != "tpu"
+    plan = _plan(Tq, Tk, D, block_q, block_k, interpret)
+    if plan is None:
+        raise ValueError(
+            f"flash_attention_lse: shapes (Tq={Tq}, Tk={Tk}, D={D}) don't "
+            "tile; check can_flash() and use the blockwise path instead")
+    km = None if key_mask is None else _prep_mask(key_mask, B, Tk)
+    offs = None
+    if q_offset is not None or k_offset is not None:
+        offs = jnp.stack(
+            [jnp.asarray(0 if q_offset is None else q_offset, jnp.int32),
+             jnp.asarray(0 if k_offset is None else k_offset, jnp.int32)])
+    return _flash_lse(q, k, v, km, offs, scale, causal, plan[0], plan[1],
+                      interpret)
+
+
+def can_flash(Tq, Tk, D, *, block_q=256, block_k=1024, interpret=None):
+    """True when the Pallas kernel can run these shapes (compiled-mode tile
+    alignment on TPU; any divisor in interpret mode)."""
+    if interpret is None:
+        interpret = jax.default_backend() != "tpu"
+    return _plan(Tq, Tk, D, block_q, block_k, interpret) is not None

@@ -320,6 +320,9 @@ class SelfAttentionLayer(BaseRecurrentConf):
     causal: bool = False
     block_size: int = 256
     use_pallas: bool = False
+    # dropout on the attention OUTPUT (post-softmax·V, pre-Wo) — the layer's
+    # inherited `dropout` drops the INPUT like every reference layer
+    attention_dropout: float = 0.0
 
 
 @register_layer_conf

@@ -204,6 +204,10 @@ class SelfAttentionLayerModule(BaseLayerModule):
         from ...parallel.ring_attention import attention_reference, \
             blockwise_attention
         c = self.conf
+        attn_rng = None
+        attn_drop = getattr(c, "attention_dropout", 0.0) or 0.0
+        if rng is not None and attn_drop > 0:
+            rng, attn_rng = jax.random.split(rng)
         x = apply_dropout(x, c.dropout, train, rng)
         B, T, _ = x.shape
         H = int(c.n_heads)
@@ -211,22 +215,23 @@ class SelfAttentionLayerModule(BaseLayerModule):
         q = (x @ params["Wq"]).reshape(B, T, H, Dh)
         k = (x @ params["Wk"]).reshape(B, T, H, Dh)
         v = (x @ params["Wv"]).reshape(B, T, H, Dh)
-        if mask is None and getattr(c, "use_pallas", False):
+        if getattr(c, "use_pallas", False):
             from ...kernels import flash_attention
             # block_size tunes the QUERY tile only; the key tile keeps the
             # kernel's swept default (1024) — forcing both to block_size
             # starved the MXU (256x256 measured ~1.7x slower than 256x1024
-            # at T=4096 on a real v5e). The Pallas kernel has no mask input;
-            # masked sequences take the blockwise path below, which matches
-            # attention_reference's key_mask semantics exactly
+            # at T=4096 on a real v5e). Key masks fold into the kernel's
+            # score tiles (fwd + both bwd), so ragged/packed batches keep
+            # the fast path; untileable shapes fall back inside the call
             out = flash_attention(q, k, v, causal=c.causal,
-                                  block_q=int(c.block_size))
+                                  block_q=int(c.block_size), key_mask=mask)
         elif T % min(int(c.block_size), T) == 0:
             out = blockwise_attention(q, k, v, block_size=int(c.block_size),
                                       causal=c.causal, key_mask=mask)
         else:
             out = attention_reference(q, k, v, causal=c.causal,
                                       key_mask=mask)
+        out = apply_dropout(out, attn_drop, train, attn_rng)
         out = out.reshape(B, T, int(c.n_out)) @ params["Wo"] + params["b"]
         out = self.activation_fn()(out)
         if mask is not None:

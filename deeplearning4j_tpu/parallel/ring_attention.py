@@ -116,24 +116,93 @@ def blockwise_attention(q, k, v, *, block_size=256, causal=False, scale=None,
     return out.transpose(0, 2, 1, 3)                      # back to BTHD
 
 
-def _ring_attention_local(q, k, v, km=None, *, causal, scale, axis_name):
+def _ring_attention_local(q, k, v, km=None, *, causal, scale, axis_name,
+                          use_flash=False, block_q=256, block_k=1024):
     """Per-shard body under shard_map: each device owns a time-slice of
     q/k/v (and of the optional key mask, which rotates with K/V); queries
     accumulate online-softmax partials as K/V blocks move around the ring
-    (ppermute over ICI)."""
+    (ppermute over ICI).
+
+    use_flash: run the Pallas flash kernel on each visiting shard (the
+    shard's global key offset drives the causal mask in-kernel) and merge
+    the per-shard (out, lse) partials by log-sum-exp — the [Tq, Tb] score
+    block never materializes. The einsum `_block_update` stays as the
+    fallback for shapes the kernel can't tile."""
     B, Tq, H, D = q.shape
     n = jax.lax.psum(1, axis_name)
     my = jax.lax.axis_index(axis_name)
-    scale = scale if scale is not None else 1.0 / jnp.sqrt(D).astype(q.dtype)
+    if use_flash:
+        # the kernel wants a hashable Python scalar, and jnp ops on
+        # constants become tracers under the shard_map trace; a TRACED
+        # caller-supplied scale can't feed the kernel — take the einsum
+        # path for it instead of crashing
+        try:
+            scale = float(scale) if scale is not None \
+                else 1.0 / float(D) ** 0.5
+        except (TypeError, jax.errors.ConcretizationTypeError):
+            use_flash = False
+    if not use_flash:
+        scale = scale if scale is not None \
+            else 1.0 / jnp.sqrt(D).astype(q.dtype)
+    perm = [(i, (i + 1) % n) for i in range(n)]
 
-    # derive accumulators from q so shard_map's varying-axis tracking sees
-    # them as seq-varying (a plain jnp.zeros would be unvarying and fail the
-    # fori_loop carry type check)
+    def rotate(kr, vr, kmr):
+        kr = jax.lax.ppermute(kr, axis_name, perm)
+        vr = jax.lax.ppermute(vr, axis_name, perm)
+        if kmr is not None:
+            kmr = jax.lax.ppermute(kmr, axis_name, perm)
+        return kr, vr, kmr
+
+    if use_flash:
+        from ..kernels.flash_attention import flash_attention_lse
+
+        # accumulators derive from q so shard_map's varying-axis tracking
+        # sees them as seq-varying; carry (normalized out, lse) in f32 and
+        # fold each visiting shard in with the standard log-sum-exp merge
+        o = (q * 0.0).astype(jnp.float32)                         # B,Tq,H,D
+        lse = (q[..., 0].transpose(0, 2, 1) * 0.0).astype(
+            jnp.float32) + NEG_INF                                # B,H,Tq
+
+        def flash_body(r, state):
+            o, lse, kr, vr, kmr = state
+            src = (my - r) % n
+
+            def visit():
+                return flash_attention_lse(
+                    q, kr, vr, causal=causal, scale=scale,
+                    key_mask=kmr, q_offset=my * Tq if causal else None,
+                    k_offset=src * Tq if causal else None,
+                    block_q=block_q, block_k=block_k)
+
+            if causal:
+                # a strictly-future shard is fully masked: skip its kernel
+                # (and its q/k/v DMAs) outright instead of streaming NEG_INF
+                out_r, lse_r = jax.lax.cond(
+                    src <= my, visit,
+                    lambda: (jnp.zeros(q.shape, q.dtype),
+                             jnp.full((B, H, Tq), NEG_INF, jnp.float32)))
+            else:
+                out_r, lse_r = visit()
+            m_new = jnp.maximum(lse, lse_r)
+            w_acc = jnp.exp(lse - m_new)
+            w_r = jnp.exp(lse_r - m_new)
+            tw = lambda w: w.transpose(0, 2, 1)[..., None]        # → B,Tq,H,1
+            o = (o * tw(w_acc) + out_r.astype(jnp.float32) * tw(w_r)) \
+                / tw(jnp.maximum(w_acc + w_r, 1e-30))
+            lse = m_new + jnp.log(jnp.maximum(w_acc + w_r, 1e-30))
+            kr, vr, kmr = rotate(kr, vr, kmr)
+            return o, lse, kr, vr, kmr
+
+        o, lse, _, _, _ = jax.lax.fori_loop(0, n, flash_body,
+                                            (o, lse, k, v, km))
+        return o.astype(q.dtype)
+
+    # einsum fallback: the same online-softmax math, materializing one
+    # [Tq, Tb] score block per ring step
     qt = q.transpose(0, 2, 1, 3)                       # B,H,Tq,D
     o = qt * 0.0
     m = qt[..., 0] * 0.0 + NEG_INF                     # B,H,Tq
     l = qt[..., 0] * 0.0
-    perm = [(i, (i + 1) % n) for i in range(n)]
     mask_fn = _causal_mask_fn(my * Tq + jnp.arange(Tq)) if causal else None
 
     def body(r, state):
@@ -147,10 +216,7 @@ def _ring_attention_local(q, k, v, km=None, *, causal, scale, axis_name):
         src = (my - r) % n
         blk = (kr, vr, src * Tq) if kmr is None else (kr, vr, src * Tq, kmr)
         (o, m, l), _ = _block_update((o, m, l), blk, q, scale, mask_fn)
-        kr = jax.lax.ppermute(kr, axis_name, perm)
-        vr = jax.lax.ppermute(vr, axis_name, perm)
-        if kmr is not None:
-            kmr = jax.lax.ppermute(kmr, axis_name, perm)
+        kr, vr, kmr = rotate(kr, vr, kmr)
         return o, m, l, kr, vr, kmr
 
     o, m, l, _, _, _ = jax.lax.fori_loop(0, n, body, (o, m, l, k, v, km))
@@ -159,26 +225,43 @@ def _ring_attention_local(q, k, v, km=None, *, causal, scale, axis_name):
 
 
 def ring_attention(q, k, v, mesh, *, causal=False, scale=None,
-                   axis_name=SEQ_AXIS, key_mask=None):
+                   axis_name=SEQ_AXIS, key_mask=None, use_flash=None,
+                   block_q=256, block_k=1024):
     """Sequence-parallel attention over `mesh`'s `axis_name` ring: time is
     sharded across devices; peak memory per device is O(T/n) and the K/V
     transfer rides the ICI ring concurrently with compute. key_mask:
-    optional [batch, time] key validity, sharded and rotated with K/V."""
+    optional [batch, time] key validity, sharded and rotated with K/V.
+
+    use_flash (default: auto) runs the Pallas flash kernel on each visiting
+    K/V shard — the per-step [Tq/n, Tk/n] score block stays in VMEM instead
+    of materializing — falling back to the einsum block update when the
+    per-shard shapes don't tile the kernel's blocks."""
+    from ..kernels.flash_attention import can_flash
+    n = mesh.shape[axis_name]
+    B, T, H, D = q.shape
+    if use_flash is None:
+        use_flash = T % n == 0 and can_flash(T // n, T // n, D,
+                                             block_q=block_q, block_k=block_k)
     spec = P(None, axis_name, None, None)
     sh = NamedSharding(mesh, spec)
     q = jax.device_put(q, sh)
     k = jax.device_put(k, sh)
     v = jax.device_put(v, sh)
     body = functools.partial(_ring_attention_local, causal=causal,
-                             scale=scale, axis_name=axis_name)
+                             scale=scale, axis_name=axis_name,
+                             use_flash=use_flash, block_q=block_q,
+                             block_k=block_k)
+    # pallas_call outputs carry no varying-mesh-axis metadata, so the flash
+    # path opts out of shard_map's vma check (the einsum path keeps it)
+    extra = {"check_vma": False} if use_flash else {}
     if key_mask is None:   # unmasked path: no mask traffic on the ring
         fn = shard_map(body, mesh=mesh, in_specs=(spec, spec, spec),
-                       out_specs=spec)
+                       out_specs=spec, **extra)
         return fn(q, k, v)
     mspec = P(None, axis_name)
     key_mask = jnp.broadcast_to(jnp.asarray(key_mask, q.dtype),
                                 q.shape[:2])
     key_mask = jax.device_put(key_mask, NamedSharding(mesh, mspec))
     fn = shard_map(body, mesh=mesh, in_specs=(spec, spec, spec, mspec),
-                   out_specs=spec)
+                   out_specs=spec, **extra)
     return fn(q, k, v, key_mask)

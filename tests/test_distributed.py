@@ -388,3 +388,59 @@ def test_ring_attention_gradients_match_reference():
     for a, b in zip(gr, gf):
         np.testing.assert_allclose(np.asarray(a), np.asarray(b),
                                    rtol=5e-4, atol=5e-5)
+
+
+@pytest.mark.parametrize("key_mask", [False, True])
+def test_ring_attention_runs_flash_kernel(key_mask):
+    """VERDICT r4 #4: the per-ring-step update must be the Pallas flash
+    kernel (per visiting shard, global key offset driving the causal mask),
+    not the materializing einsum — proven by counting kernel invocations —
+    and the flash and einsum ring paths must agree with the reference."""
+    import importlib
+    fa = importlib.import_module("deeplearning4j_tpu.kernels.flash_attention")
+    mesh = make_mesh(n_data=1, n_seq=8)
+    rng = np.random.default_rng(5)
+    q, k, v = _qkv(rng, T=64)
+    mask = None
+    if key_mask:
+        m = (rng.random((2, 64)) > 0.4).astype(np.float32)
+        m[:, 0] = 1.0
+        mask = jnp.asarray(m)
+
+    calls = []
+    orig = fa._flash_forward
+    fa._flash_forward = lambda *a, **kw: (calls.append(1), orig(*a, **kw))[1]
+    try:
+        ring = ring_attention(q, k, v, mesh, causal=True, key_mask=mask)
+    finally:
+        fa._flash_forward = orig
+    assert calls, "ring attention never invoked the flash kernel"
+    full = attention_reference(q, k, v, causal=True, key_mask=mask)
+    np.testing.assert_allclose(np.asarray(ring), np.asarray(full),
+                               rtol=2e-4, atol=2e-5)
+    # einsum fallback (use_flash=False) stays available and agrees
+    ring_e = ring_attention(q, k, v, mesh, causal=True, key_mask=mask,
+                            use_flash=False)
+    np.testing.assert_allclose(np.asarray(ring_e), np.asarray(full),
+                               rtol=2e-4, atol=2e-5)
+
+
+def test_ring_attention_flash_gradients_match_reference():
+    """Training through the flash-in-ring path: gradients must match full
+    attention (the per-step custom VJP + the log-sum-exp merge, including
+    the LSE cotangent's fold into the delta term)."""
+    mesh = make_mesh(n_data=1, n_seq=8)
+    q, k, v = _qkv(np.random.default_rng(6), T=64, H=2)
+
+    def loss_ring(q, k, v):
+        return jnp.sum(ring_attention(q, k, v, mesh, causal=True,
+                                      use_flash=True) ** 2)
+
+    def loss_full(q, k, v):
+        return jnp.sum(attention_reference(q, k, v, causal=True) ** 2)
+
+    gr = jax.grad(loss_ring, argnums=(0, 1, 2))(q, k, v)
+    gf = jax.grad(loss_full, argnums=(0, 1, 2))(q, k, v)
+    for a, b in zip(gr, gf):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=5e-4, atol=5e-5)
