@@ -99,6 +99,15 @@ def load_mnist(train=True, num_examples=None):
     if img_path:
         imgs = _read_idx_images(img_path).astype(np.float32) / 255.0
         labels = _read_idx_labels(lab_path).astype(np.int64)
+        if num_examples is not None and len(imgs) < num_examples:
+            # the committed real fixture holds 1297/500 examples; callers
+            # sizing epochs by num_examples must hear about the shortfall
+            # instead of silently training on fewer samples
+            import warnings
+            warnings.warn(
+                f"MNIST source {os.path.dirname(img_path)} holds only "
+                f"{len(imgs)} examples ({num_examples} requested); using all "
+                f"{len(imgs)}", stacklevel=2)
     else:
         n = num_examples or (60000 if train else 10000)
         imgs, labels = _synthetic_mnist(n, seed=0 if train else 1)

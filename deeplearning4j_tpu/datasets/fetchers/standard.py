@@ -94,43 +94,137 @@ class IrisDataSetIterator(_ArrayIterator):
         super().__init__(x[:num_examples], y[:num_examples], batch_size)
 
 
+def _find_cifar_dir():
+    """First directory holding CIFAR-format binary batches: CIFAR_DIR wins
+    (a full real CIFAR-10 download drops in unchanged), then local caches,
+    then the committed real-photo fixture tests/fixtures/cifar_real (960
+    train / 240 test genuine 32x32 photograph crops in the CIFAR binary
+    record layout — real pixels, NOT the CIFAR-10 classes; provenance in
+    tools/make_cifar_fixture.py)."""
+    candidates = [
+        os.environ.get("CIFAR_DIR"),
+        os.path.expanduser("~/.deeplearning4j_tpu/cifar"),
+        "/root/data/cifar",
+        os.path.join(os.path.dirname(__file__), os.pardir, os.pardir,
+                     os.pardir, "tests", "fixtures", "cifar_real"),
+    ]
+    def has(d, base):
+        return any(os.path.exists(os.path.join(d, base + sfx))
+                   for sfx in ("", ".gz"))
+
+    for d in candidates:
+        if not d or not os.path.isdir(d):
+            continue
+        # require BOTH splits: a partial copy that satisfied only the train
+        # side would silently pair real train data with the synthetic test
+        # fallback — and publish a bogus accuracy
+        if has(d, "data_batch_1.bin") and has(d, "test_batch.bin"):
+            return d
+        import warnings
+        warnings.warn(f"CIFAR dir {d} is missing a split "
+                      "(need data_batch_1.bin and test_batch.bin, raw or "
+                      ".gz); skipping it", stacklevel=2)
+    return None
+
+
+def _read_cifar_records(path):
+    """label/RGB-plane records (CifarDataSetIterator.java's layout), raw or
+    gzipped. Returns (images NHWC uint8, labels uint8)."""
+    import gzip
+    op = gzip.open if path.endswith(".gz") else open
+    with op(path, "rb") as f:
+        raw = np.frombuffer(f.read(), np.uint8)
+    recs = raw.reshape(-1, 3073)
+    return recs[:, 1:].reshape(-1, 3, 32, 32).transpose(0, 2, 3, 1), recs[:, 0]
+
+
+def load_cifar(train=True, num_examples=None):
+    """(images [n,32,32,3] float32 in [0,1], labels [n] int64, class_names
+    list | None). Falls back to deterministic synthetic data (clearly not
+    real photos) when no local copy or fixture exists."""
+    d = _find_cifar_dir()
+    if d is not None:
+        files = [f"data_batch_{i}.bin" for i in range(1, 6)] if train \
+            else ["test_batch.bin"]
+        xs, ys = [], []
+        for f in files:
+            for suffix in ("", ".gz"):
+                p = os.path.join(d, f + suffix)
+                if os.path.exists(p):
+                    x, y = _read_cifar_records(p)
+                    xs.append(x)
+                    ys.append(y)
+                    break
+        if xs:
+            names = None
+            meta = os.path.join(d, "batches.meta.txt")
+            if os.path.exists(meta):
+                with open(meta) as f:
+                    names = [l.strip() for l in f if l.strip()]
+            x = (np.concatenate(xs) / 255.0).astype(np.float32)
+            y = np.concatenate(ys).astype(np.int64)
+            if num_examples is not None:
+                x, y = x[:num_examples], y[:num_examples]
+            return x, y, names
+    n = num_examples or 1000
+    rng = np.random.default_rng(777 if train else 778)
+    ys_i = np.tile(np.arange(10), n // 10 + 1)[:n]
+    base = rng.normal(size=(10, 32, 32, 3))
+    x = base[ys_i] * 0.4 + rng.normal(scale=0.3, size=(n, 32, 32, 3))
+    x = ((x - x.min()) / (x.max() - x.min())).astype(np.float32)
+    return x, ys_i.astype(np.int64), None
+
+
+def real32_gate_accuracy(epochs=10, seed=3):
+    """The real-photo 32x32 accuracy gate, shared by bench.py
+    (`real32_test_acc`) and tests/test_real_cifar.py so the benched number
+    and the tested threshold can never train on diverged recipes: small
+    convnet (zoo.cifar_convnet) + horizontal-flip augmentation on the
+    committed cifar_real fixture, evaluated on the spatially-split held-out
+    crops. Returns accuracy, or None when only synthetic data is found."""
+    from ..dataset import DataSet
+    from ..iterator.base import ListDataSetIterator
+    from ...zoo.models import cifar_convnet
+
+    if _find_cifar_dir() is None:
+        return None  # synthetic fallback engaged; accuracy would be bogus
+    x, y, _ = load_cifar(train=True)
+    xa = np.concatenate([x, x[:, :, ::-1]])      # horizontal flips
+    ya = np.concatenate([y, y])
+    order = np.random.default_rng(seed).permutation(len(xa))
+    xa = xa[order]
+    yh = np.eye(10, dtype=np.float32)[ya[order]]
+    sets = [DataSet(xa[i:i + 64], yh[i:i + 64])
+            for i in range(0, len(xa), 64)]
+    net = cifar_convnet()
+    net.init()
+    net.fit(ListDataSetIterator(sets), epochs=epochs)
+    xt, yt, _ = load_cifar(train=False)
+    pred = np.argmax(np.asarray(net.output(xt)), axis=1)
+    return float((pred == yt).mean())
+
+
 class CifarDataSetIterator(_ArrayIterator):
     """(reference: datasets/iterator/impl/CifarDataSetIterator.java — 32x32x3,
-    10 classes). Local CIFAR-10 binary batches via CIFAR_DIR, else synthetic
-    class-conditional images (NHWC float32 in [0,1])."""
+    10 classes). Reads CIFAR-10 binary batches (label byte + 3072 RGB plane
+    bytes per record) from CIFAR_DIR / local caches / the committed
+    real-photo fixture, else synthesizes class-conditional images. Labels
+    one-hot to 10 columns regardless of how many classes the data uses, so
+    model shapes match real CIFAR-10. `labels` carries class names when the
+    source ships a batches.meta.txt."""
 
     H = W = 32
     C = 3
     CLASSES = 10
 
-    def __init__(self, batch_size=32, num_examples=1000, train=True):
-        cdir = os.environ.get("CIFAR_DIR")
-        x = y = None
-        if cdir and os.path.isdir(cdir):
-            files = [f"data_batch_{i}.bin" for i in range(1, 6)] if train \
-                else ["test_batch.bin"]
-            xs, ys = [], []
-            for f in files:
-                p = os.path.join(cdir, f)
-                if not os.path.exists(p):
-                    continue
-                raw = np.fromfile(p, np.uint8).reshape(-1, 3073)
-                ys.append(raw[:, 0])
-                xs.append(raw[:, 1:].reshape(-1, 3, 32, 32).transpose(0, 2, 3, 1))
-            if xs:
-                x = (np.concatenate(xs) / 255.0).astype(np.float32)
-                y = np.eye(self.CLASSES, dtype=np.float32)[np.concatenate(ys)]
-        if x is None:
-            rng = np.random.default_rng(777 if train else 778)
-            ys_i = np.tile(np.arange(self.CLASSES),
-                           num_examples // self.CLASSES + 1)[:num_examples]
-            # class-conditional blob pattern + noise
-            base = rng.normal(size=(self.CLASSES, self.H, self.W, self.C))
-            x = (base[ys_i] * 0.4 +
-                 rng.normal(scale=0.3, size=(num_examples, self.H, self.W, self.C)))
-            x = ((x - x.min()) / (x.max() - x.min())).astype(np.float32)
-            y = np.eye(self.CLASSES, dtype=np.float32)[ys_i]
-        super().__init__(x[:num_examples], y[:num_examples], batch_size)
+    def __init__(self, batch_size=32, num_examples=None, train=True,
+                 shuffle=False, seed=123):
+        x, ys, self.labels = load_cifar(train, num_examples)
+        if shuffle:
+            idx = np.random.default_rng(seed).permutation(len(x))
+            x, ys = x[idx], ys[idx]
+        y = np.eye(self.CLASSES, dtype=np.float32)[ys]
+        super().__init__(x, y, batch_size)
 
 
 class LFWDataSetIterator(_ArrayIterator):

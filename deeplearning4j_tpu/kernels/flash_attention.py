@@ -516,9 +516,10 @@ def flash_attention(q, k, v, *, causal=False, scale=None, key_mask=None,
     the score tiles of the forward and both backward kernels (packed/ragged
     batches keep the fast path).
 
-    Falls back to the pure-JAX reference path when the sequence doesn't tile
-    into the requested blocks or Pallas can't run (shape/platform); callers
-    may use it unconditionally."""
+    Falls back to the pure-JAX blockwise scan (O(T_block) memory) when the
+    sequence doesn't tile into the requested blocks but a sane key-block
+    divisor exists, and to the materializing reference only as a last
+    resort; callers may use it unconditionally."""
     B, Tq, H, D = q.shape
     Tk = k.shape[1]
     if scale is None:

@@ -20,11 +20,12 @@ from ..conf.graph_configuration import (ComputationGraphConfiguration,
 from ..conf.configuration import BackpropType
 from ..layers.base import create_layer
 from ..layers import feedforward, convolution, recurrent, misc, variational  # noqa: F401
+from ..multistep import MultiStepTrainable
 from ..updaters import apply_gradient_normalization
 from ...optimize.listeners import resolve_listeners
 
 
-class ComputationGraph:
+class ComputationGraph(MultiStepTrainable):
     def __init__(self, conf: ComputationGraphConfiguration):
         self.conf = conf
         self.order = conf.topo_sort()
@@ -283,9 +284,13 @@ class ComputationGraph:
             self._jit_cache[key] = self._make_train_step(tbptt=(key == "tbptt"))
         return self._jit_cache[key]
 
-    def fit(self, data, labels=None, epochs=1):
+    def fit(self, data, labels=None, epochs=1, steps_per_execution=1):
         """Accepts MultiDataSet / DataSet / iterator thereof / (x, y)
-        (reference: fit(DataSetIterator) :671, fit(MultiDataSet) :740)."""
+        (reference: fit(DataSetIterator) :671, fit(MultiDataSet) :740).
+
+        steps_per_execution=K compiles K optimizer steps into ONE executable
+        (lax.scan with donated carry, nn/multistep.py) — one host dispatch
+        per K minibatches; listeners fire on a K-step cadence."""
         from ...datasets.dataset import DataSet, MultiDataSet
         from ...datasets.iterator.base import as_iterator, DataSetIterator
         if labels is not None:
@@ -298,22 +303,26 @@ class ComputationGraph:
             items = list(data)
         else:
             items = as_iterator(data)
+        K = max(1, int(steps_per_execution))
         for _ in range(epochs):
             for listener in self.listeners:
                 listener.on_epoch_start(self)
             if hasattr(items, "reset"):
                 items.reset()
-            for ds in items:
-                self.fit_batch(ds)
+            if K > 1:
+                self._fit_grouped(items, K)
+            else:
+                for ds in items:
+                    self.fit_batch(ds)
             for listener in self.listeners:
                 listener.on_epoch_end(self)
             self.epoch_count += 1
         return self
 
-    def fit_batch(self, ds):
+    def _prep_batch(self, ds):
+        """(inputs, labels, masks, lmasks) lists of device arrays — the
+        per-step leaves both fit_batch and the scanned path consume."""
         from ...datasets.dataset import DataSet, MultiDataSet
-        if self.params is None:
-            self.init()
         if isinstance(ds, DataSet):
             ds = MultiDataSet([ds.features], [ds.labels],
                               None if ds.features_mask is None else [ds.features_mask],
@@ -324,6 +333,30 @@ class ComputationGraph:
             [None if m is None else jnp.asarray(m, self._dtype) for m in ds.features_masks]
         lmasks = None if ds.labels_masks is None else \
             [None if m is None else jnp.asarray(m, self._dtype) for m in ds.labels_masks]
+        return inputs, labels, masks, lmasks
+
+    def _scan_loss(self, p, states, inputs, labels, rng, masks, lmasks):
+        score, (new_states, _) = self._loss(p, states, inputs, labels,
+                                            train=True, rng=rng, masks=masks,
+                                            label_masks=lmasks)
+        return score, new_states
+
+    def _multi_step_mode(self, prepped):
+        from ..conf.configuration import OptimizationAlgorithm
+        inputs = prepped[0]
+        if self.conf.optimization_algo != OptimizationAlgorithm.STOCHASTIC_GRADIENT_DESCENT:
+            return None
+        T = max((x.shape[1] for x in inputs
+                 if hasattr(x, "ndim") and x.ndim == 3), default=0)
+        if (self.conf.backprop_type == BackpropType.TRUNCATED_BPTT
+                and T > self.conf.tbptt_fwd_length):
+            return None  # graph TBPTT groups run per-batch
+        return None if self._listeners_need_gradients() else "std"
+
+    def fit_batch(self, ds):
+        if self.params is None:
+            self.init()
+        inputs, labels, masks, lmasks = self._prep_batch(ds)
         self._rng, step_rng = jax.random.split(self._rng)
         from ..conf.configuration import OptimizationAlgorithm
         if self.conf.optimization_algo != OptimizationAlgorithm.STOCHASTIC_GRADIENT_DESCENT:

@@ -25,6 +25,7 @@ import optax
 from ..conf.configuration import MultiLayerConfiguration, BackpropType
 from ..layers.base import create_layer
 from ..layers import feedforward, convolution, recurrent, misc, variational  # noqa: F401 (register impls)
+from ..multistep import MultiStepTrainable
 from ..updaters import apply_gradient_normalization
 from ...optimize.listeners import resolve_listeners
 
@@ -33,7 +34,7 @@ def _is_weight_key(k):
     return not (k.endswith("b") or k in ("gamma", "beta", "centers", "mean", "var"))
 
 
-class MultiLayerNetwork:
+class MultiLayerNetwork(MultiStepTrainable):
     def __init__(self, conf: MultiLayerConfiguration):
         self.conf = conf
         self.layers = [create_layer(lc) for lc in conf.layers]
@@ -261,34 +262,153 @@ class MultiLayerNetwork:
             self._jit_cache[key] = self._make_train_step(tbptt="tbptt" in key)
         return self._jit_cache[key]
 
-    def fit(self, data, labels=None, epochs=1):
+    def fit(self, data, labels=None, epochs=1, steps_per_execution=1):
         """Train. `data` may be a DataSetIterator-like, a DataSet, or (x, y)
-        arrays (reference: fit(DataSetIterator) :902 and fit(INDArray,INDArray))."""
+        arrays (reference: fit(DataSetIterator) :902 and fit(INDArray,INDArray)).
+
+        steps_per_execution=K compiles K optimizer steps into ONE executable
+        (lax.scan with donated carry — see nn/multistep.py): one host
+        dispatch per K minibatches instead of the reference's per-minibatch
+        loop (StochasticGradientDescent.java:51-72). Listeners then fire on
+        a K-step cadence; ragged tails and incompatible groups (TBPTT
+        windowing, non-SGD solvers, mismatched shapes) fall back to
+        per-batch steps."""
         from ...datasets.dataset import DataSet
         from ...datasets.iterator.base import as_iterator
         if labels is not None:
             data = DataSet(data, labels)
         it = as_iterator(data)
+        K = max(1, int(steps_per_execution))
         for _ in range(epochs):
             for listener in self.listeners:
                 listener.on_epoch_start(self)
             it.reset()
-            for ds in it:
-                self.fit_batch(ds)
+            if K > 1:
+                self._fit_grouped(it, K)
+            else:
+                for ds in it:
+                    self.fit_batch(ds)
             for listener in self.listeners:
                 listener.on_epoch_end(self)
             self.epoch_count += 1
         return self
 
-    def fit_batch(self, ds):
-        """One minibatch step — one XLA computation on device."""
-        if self.params is None:
-            self.init()
+    def _prep_batch(self, ds):
+        """(x, y, mask, lmask) as device arrays — the per-step leaves both
+        fit_batch and the scanned multi-step path consume."""
         x = jnp.asarray(ds.features, self._dtype) \
             if not str(ds.features.dtype).startswith("int") else jnp.asarray(ds.features)
         y = jnp.asarray(ds.labels, self._dtype)
         mask = None if ds.features_mask is None else jnp.asarray(ds.features_mask, self._dtype)
         lmask = None if ds.labels_mask is None else jnp.asarray(ds.labels_mask, self._dtype)
+        return x, y, mask, lmask
+
+    def _scan_loss(self, p, states, x, y, rng, mask, lmask):
+        score, (new_states, _) = self._loss(p, states, x, y, train=True,
+                                            rng=rng, mask=mask,
+                                            label_mask=lmask)
+        return score, new_states
+
+    def _multi_step_mode(self, prepped):
+        from ..conf.configuration import OptimizationAlgorithm
+        x = prepped[0]
+        if self.conf.optimization_algo != OptimizationAlgorithm.STOCHASTIC_GRADIENT_DESCENT:
+            return None
+        if self._listeners_need_gradients():
+            return None
+        if (self.conf.backprop_type == BackpropType.TRUNCATED_BPTT
+                and x.ndim == 3 and x.shape[1] > self.conf.tbptt_fwd_length):
+            # windows scan only when they tile the sequence exactly
+            return "tbptt" if x.shape[1] % self.conf.tbptt_fwd_length == 0 \
+                else None
+        return "std"
+
+    def _prepare_tbptt(self, prepped):
+        """Flatten K TBPTT batches into one [K*W, ...] window scan: every
+        batch contributes W = T/L windows, a `first` flag resets the carried
+        recurrent state at batch boundaries, and an rng table replays
+        EXACTLY the splits K fit_batch calls would draw (one step key per
+        batch, one sub-key per window), advancing self._rng identically."""
+        L = self.conf.tbptt_fwd_length
+        T = prepped[0][0].shape[1]
+        W = T // L
+        K = len(prepped)
+
+        def win(a, dims3):
+            # [B, T, ...] -> [W, B, L, ...]; non-temporal arrays replicate
+            if a is None:
+                return None
+            if a.ndim in dims3 and a.shape[1] == T:
+                parts = [a[:, w * L:(w + 1) * L] for w in range(W)]
+                return jnp.stack(parts)
+            return jnp.stack([a] * W)
+
+        stacked = []
+        for (x, y, mask, lmask) in prepped:
+            stacked.append((win(x, (3,)), win(y, (3,)), win(mask, (2, 3)),
+                            win(lmask, (2, 3))))
+        # [K, W, ...] -> [K*W, ...]
+        flat = jax.tree_util.tree_map(
+            lambda *a: jnp.concatenate(a), *stacked)
+        firsts = jnp.tile(jnp.arange(W) == 0, K)              # [K*W]
+
+        @jax.jit
+        def rng_table(r):
+            def outer(r, _):
+                r, step = jax.random.split(r)
+
+                def inner(s, _):
+                    s, sub = jax.random.split(s)
+                    return s, sub
+                _, subs = jax.lax.scan(inner, step, None, length=W)
+                return r, subs
+            r, tab = jax.lax.scan(outer, r, None, length=K)
+            return r, tab.reshape((K * W,) + tab.shape[2:])
+
+        self._rng, rngs = rng_table(self._rng)
+        return "tbptt", (flat + (firsts, rngs)), K
+
+    def _run_prepared_tbptt(self, stacked, K):
+        tx = self._tx
+        if "multi_tbptt" not in self._jit_cache:
+            def multi_tbptt(params, opt_state, states, carries, stacked):
+                def body(carry, batch):
+                    params, opt_state, states, carries = carry
+                    x, y, mask, lmask, first, sub = batch
+                    carries = jax.tree_util.tree_map(
+                        lambda c: jnp.where(first, jnp.zeros_like(c), c),
+                        carries)
+
+                    def loss_fn(p):
+                        return self._loss(p, states, x, y, train=True,
+                                          rng=sub, mask=mask,
+                                          label_mask=lmask,
+                                          initial_carries=carries)
+                    (score, (new_states, new_carries)), grads = \
+                        jax.value_and_grad(loss_fn, has_aux=True)(params)
+                    grads = self._normalize_grads(grads)
+                    updates, opt_state = tx.update(grads, opt_state, params)
+                    params = optax.apply_updates(params, updates)
+                    return (params, opt_state, new_states, new_carries), score
+
+                (params, opt_state, states, _), scores = jax.lax.scan(
+                    body, (params, opt_state, states, carries), stacked)
+                return params, opt_state, states, scores
+            self._jit_cache["multi_tbptt"] = jax.jit(
+                multi_tbptt, donate_argnums=(0, 1, 2, 3))
+        B = jax.tree_util.tree_leaves(stacked)[0].shape[1]
+        carries = self._zero_carries(B, self._dtype)
+        (self.params, self.opt_state, self.states,
+         win_scores) = self._jit_cache["multi_tbptt"](
+            self.params, self.opt_state, self.states, carries, stacked)
+        # per-batch score = mean over that batch's windows (singles parity)
+        return win_scores.reshape(K, -1).mean(axis=1)
+
+    def fit_batch(self, ds):
+        """One minibatch step — one XLA computation on device."""
+        if self.params is None:
+            self.init()
+        x, y, mask, lmask = self._prep_batch(ds)
         self._rng, step_rng = jax.random.split(self._rng)
 
         from ..conf.configuration import OptimizationAlgorithm

@@ -1,0 +1,137 @@
+"""steps_per_execution: K optimizer steps inside ONE compiled executable.
+
+The reference's training loop is a Java per-minibatch host loop
+(optimize/solvers/StochasticGradientDescent.java:51-72 — fetch batch, one
+gradient step, repeat), which SURVEY §7 marks as the thing to compile away.
+Round 4 measured why: through a remote PJRT relay, per-step host dispatch
+phases swing 1.3 ms ↔ 21 ms hours apart, so any small-model number timed
+across K separate dispatches measures the relay, not the model.
+
+This mixin rolls the loop INSIDE the executable: `lax.scan` over K
+pre-staged device batches with the (params, opt_state, states, rng) carry
+donated, so training pays ONE dispatch per K steps and the whole chain —
+forward, backward, updater, BN stat update, rng split — stays on device.
+Semantics are identical to K fit_batch calls: the rng chain splits the same
+way, per-layer states thread sequentially, and scores come back per step.
+
+TBPTT batches scan too (MultiLayerNetwork): each batch's windows flatten
+into the scan with a per-window carry that resets at batch boundaries, and
+a precomputed rng table replays exactly the splits the per-batch path would
+have drawn. Configs the scan can't honor (non-SGD solvers, ragged TBPTT
+windows, gradient-hungry listeners, mismatched shapes within a group) fall
+back to per-batch steps.
+
+Each class provides:
+  _prep_batch(ds)    -> per-step pytree of device arrays (masks may be None)
+  _scan_loss(p, states, x, y, rng, mask, lmask) -> (score, new_states)
+  _multi_step_mode(prepped) -> "std" | "tbptt" | None
+
+Listeners fire once per execution with the advanced iteration count — a
+well-defined K-step cadence; per-step scores stay available on device as
+`last_scores`.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import optax
+
+
+class MultiStepTrainable:
+    def _make_multi_step(self):
+        tx = self._tx
+
+        def multi_step(params, opt_state, states, rng, stacked):
+            def body(carry, batch):
+                params, opt_state, states, rng = carry
+                x, y, mask, lmask = batch
+                rng, step_rng = jax.random.split(rng)
+                (score, new_states), grads = jax.value_and_grad(
+                    self._scan_loss, has_aux=True)(
+                        params, states, x, y, step_rng, mask, lmask)
+                grads = self._normalize_grads(grads)
+                updates, opt_state = tx.update(grads, opt_state, params)
+                params = optax.apply_updates(params, updates)
+                return (params, opt_state, new_states, rng), score
+
+            (params, opt_state, states, rng), scores = jax.lax.scan(
+                body, (params, opt_state, states, rng), stacked)
+            return params, opt_state, states, rng, scores
+
+        # the batch stack is NOT donated: callers may reuse prepared groups
+        return jax.jit(multi_step, donate_argnums=(0, 1, 2, 3))
+
+    def prepare_steps(self, group):
+        """Stack a list of same-shaped DataSets into one device-resident
+        execution plan for `fit_prepared`, or None when this group can't
+        scan. The plan is reusable: its batch leaves are never donated
+        (re-running a TBPTT plan replays the same rng table; the std plan
+        draws fresh rngs from the carried chain)."""
+        if self.params is None:
+            self.init()
+        # decide eligibility from the FIRST batch alone before paying the
+        # host->device transfer for the whole group — an ineligible config
+        # would otherwise re-prep (and re-transfer) every batch in the
+        # fit_batch fallback
+        first = self._prep_batch(group[0])
+        mode = self._multi_step_mode(first)
+        if mode is None:
+            return None
+        prepped = [first] + [self._prep_batch(ds) for ds in group[1:]]
+        try:
+            if mode == "std":
+                stacked = jax.tree_util.tree_map(lambda *a: jnp.stack(a),
+                                                 *prepped)
+                return "std", stacked, len(group)
+            return self._prepare_tbptt(prepped)   # MLN-only; may be None
+        except ValueError:
+            return None  # shape or mask-structure mismatch within the group
+
+    def fit_prepared(self, prepared):
+        """Run one compiled multi-step execution over a `prepare_steps`
+        plan."""
+        mode, stacked, K = prepared
+        if mode == "std":
+            if "multi" not in self._jit_cache:
+                self._jit_cache["multi"] = self._make_multi_step()
+            (self.params, self.opt_state, self.states, self._rng,
+             scores) = self._jit_cache["multi"](
+                self.params, self.opt_state, self.states, self._rng, stacked)
+        else:
+            scores = self._run_prepared_tbptt(stacked, K)
+        self.last_scores = scores          # [K] device array
+        self.score_value = scores[-1]      # device scalar; syncs lazily
+        self.iteration_count += int(K)
+        B = jax.tree_util.tree_leaves(stacked)[0].shape[1]
+        for listener in self.listeners:
+            if hasattr(listener, "record_batch_size"):
+                listener.record_batch_size(int(K) * int(B))
+            listener.iteration_done(self, self.iteration_count)
+        return self
+
+    def _fit_grouped(self, it, K):
+        """One epoch: full groups of K go through the compiled scan; ragged
+        tails and incompatible groups fall back to per-batch steps."""
+        group = []
+
+        def flush(group):
+            prepared = self.prepare_steps(group) if len(group) == K else None
+            if prepared is not None:
+                self.fit_prepared(prepared)
+            else:
+                for ds in group:
+                    self.fit_batch(ds)
+
+        for ds in it:
+            group.append(ds)
+            if len(group) == K:
+                flush(group)
+                group = []
+        if group:
+            flush(group)
+
+    def _listeners_need_gradients(self):
+        return any(getattr(l, "wants_gradients", False) for l in self.listeners)
+
+    def _prepare_tbptt(self, prepped):
+        return None  # ComputationGraph: TBPTT groups fall back to fit_batch

@@ -154,7 +154,15 @@ def _ring_attention_local(q, k, v, km=None, *, causal, scale, axis_name,
         return kr, vr, kmr
 
     if use_flash:
-        from ..kernels.flash_attention import flash_attention_lse
+        from ..kernels.flash_attention import (flash_attention,
+                                               flash_attention_lse)
+
+        if n == 1:
+            # degenerate ring: one shard holds everything — the kernel
+            # alone IS the answer; no LSE emission, no merge passes
+            return flash_attention(q, k, v, causal=causal, scale=scale,
+                                   key_mask=km, block_q=block_q,
+                                   block_k=block_k)
 
         # accumulators derive from q so shard_map's varying-axis tracking
         # sees them as seq-varying; carry (normalized out, lse) in f32 and

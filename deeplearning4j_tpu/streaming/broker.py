@@ -271,11 +271,24 @@ class BrokerClient:
 from .routes import StreamSink, StreamSource  # noqa: E402 (adapters below)
 
 
+def _private_client(client):
+    """A BrokerClient of the same endpoint on its OWN socket. _request holds
+    the client lock for a whole round and a poll can block broker-side up to
+    MAX_POLL_S — a client shared between a polling BrokerSource and a
+    BrokerSink would stall publishes for seconds per poll (ADVICE r4), so
+    the adapters below always take a private connection."""
+    return BrokerClient(host=client.host, port=client.port,
+                        retries=client.retries,
+                        retry_interval=client.retry_interval)
+
+
 class BrokerSource(StreamSource):
-    """StreamSource over a broker topic (NDArrayConsumer analog)."""
+    """StreamSource over a broker topic (NDArrayConsumer analog). The passed
+    client identifies the endpoint; polling runs on a private connection so
+    long poll rounds never block a co-routed sink's publishes."""
 
     def __init__(self, client: BrokerClient, topic: str):
-        self.client = client
+        self.client = _private_client(client)
         self.topic = topic
 
     def poll(self, timeout=None):
@@ -288,10 +301,11 @@ class BrokerSource(StreamSource):
 
 
 class BrokerSink(StreamSink):
-    """StreamSink over a broker topic (NDArrayPublisher analog)."""
+    """StreamSink over a broker topic (NDArrayPublisher analog). Publishes
+    on a private connection (see _private_client)."""
 
     def __init__(self, client: BrokerClient, topic: str):
-        self.client = client
+        self.client = _private_client(client)
         self.topic = topic
 
     def publish(self, message):

@@ -30,8 +30,21 @@ def _sharding_meta(params):
     for path, leaf in jax.tree_util.tree_flatten_with_path(params)[0]:
         sh = getattr(leaf, "sharding", None)
         if isinstance(sh, NamedSharding):
-            mesh_info = {"axis_names": list(sh.mesh.axis_names),
-                         "shape": [int(s) for s in sh.mesh.devices.shape]}
+            info = {"axis_names": list(sh.mesh.axis_names),
+                    "shape": [int(s) for s in sh.mesh.devices.shape]}
+            if mesh_info is not None and info != mesh_info:
+                # leaves on two DIFFERENT meshes: recording one mesh against
+                # all specs would silently mis-derive shardings on restore —
+                # drop the metadata and fall back to the default-derivation
+                # path instead (ADVICE r4)
+                import warnings
+                warnings.warn(
+                    "params span multiple meshes "
+                    f"({mesh_info} vs {info}); omitting sharding metadata "
+                    "from the checkpoint — restore will use default "
+                    "shardings", stacklevel=3)
+                return {"mesh": None, "specs": {}}
+            mesh_info = info
             specs[jax.tree_util.keystr(path)] = [
                 list(p) if isinstance(p, tuple) else p for p in sh.spec]
     return {"mesh": mesh_info, "specs": specs}

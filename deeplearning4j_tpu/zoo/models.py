@@ -42,6 +42,34 @@ def lenet_mnist(seed=12345, updater=None):
     return MultiLayerNetwork(conf)
 
 
+def cifar_convnet(seed=12345, num_classes=10, updater=None):
+    """Small conv net for 32x32x3 CIFAR-format data (mirrors the reference's
+    Cifar example scale: two conv/pool blocks + dense head). Gated on the
+    committed real-photo fixture (tests/fixtures/cifar_real) in bench.py as
+    `real32_test_acc`."""
+    conf = (NeuralNetConfiguration.builder()
+            .seed(seed)
+            .updater(updater or Adam(1e-3))
+            .weight_init("relu")
+            .list()
+            .layer(ConvolutionLayer(kernel_size=(3, 3), stride=(1, 1),
+                                    n_out=32, activation="relu",
+                                    padding=(1, 1)))
+            .layer(SubsamplingLayer(pooling_type="max", kernel_size=(2, 2),
+                                    stride=(2, 2)))
+            .layer(ConvolutionLayer(kernel_size=(3, 3), stride=(1, 1),
+                                    n_out=64, activation="relu",
+                                    padding=(1, 1)))
+            .layer(SubsamplingLayer(pooling_type="max", kernel_size=(2, 2),
+                                    stride=(2, 2)))
+            .layer(DenseLayer(n_out=256, activation="relu"))
+            .layer(OutputLayer(n_out=num_classes, activation="softmax",
+                               loss="MCXENT"))
+            .input_type(InputType.convolutional(32, 32, 3))
+            .build())
+    return MultiLayerNetwork(conf)
+
+
 def mlp_mnist(seed=12345, hidden=512):
     conf = (NeuralNetConfiguration.builder()
             .seed(seed).updater(Adam(1e-3)).weight_init("relu")

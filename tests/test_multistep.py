@@ -1,0 +1,206 @@
+"""steps_per_execution: K train steps compiled into one executable
+(nn/multistep.py) must be SEMANTICALLY IDENTICAL to K fit_batch calls —
+same rng chain, same per-layer state threading, same scores — with
+listeners firing on the documented K-step cadence, and graceful per-batch
+fallback whenever a group can't scan."""
+import numpy as np
+import jax
+import jax.numpy as jnp
+import pytest
+
+from deeplearning4j_tpu import (NeuralNetConfiguration, InputType, DenseLayer,
+                                OutputLayer, BatchNormalization,
+                                MultiLayerNetwork, DataSet,
+                                ListDataSetIterator, Sgd, Adam)
+from deeplearning4j_tpu.optimize.listeners import IterationListener
+
+
+def _mk_net(seed=5, dropout=None, bn=False, tbptt=False):
+    b = NeuralNetConfiguration.builder().seed(seed).updater(Adam(1e-2)).list()
+    b = b.layer(DenseLayer(n_out=16, activation="tanh",
+                           dropout=dropout))
+    if bn:
+        b = b.layer(BatchNormalization())
+    b = b.layer(OutputLayer(n_out=3, activation="softmax", loss="MCXENT"))
+    conf = b.input_type(InputType.feed_forward(8)).build()
+    return MultiLayerNetwork(conf).init()
+
+
+def _batches(n, batch=16, seed=0):
+    rng = np.random.default_rng(seed)
+    out = []
+    for _ in range(n):
+        x = rng.normal(size=(batch, 8)).astype(np.float32)
+        y = np.eye(3, dtype=np.float32)[rng.integers(0, 3, batch)]
+        out.append(DataSet(x, y))
+    return out
+
+
+@pytest.mark.parametrize("dropout,bn", [(None, False), (0.3, False),
+                                        (None, True)])
+def test_multi_step_matches_per_batch(dropout, bn):
+    """K-step scan == K singles: params, BN running state, and the rng
+    chain (dropout masks) all line up."""
+    sets = _batches(8)
+    a = _mk_net(dropout=dropout, bn=bn)
+    b = _mk_net(dropout=dropout, bn=bn)
+    a.fit(ListDataSetIterator(sets))
+    b.fit(ListDataSetIterator(sets), steps_per_execution=4)
+    np.testing.assert_allclose(a.get_flat_params(), b.get_flat_params(),
+                               rtol=1e-5, atol=1e-6)
+    for sa, sb in zip(jax.tree_util.tree_leaves(a.states),
+                      jax.tree_util.tree_leaves(b.states)):
+        np.testing.assert_allclose(np.asarray(sa), np.asarray(sb),
+                                   rtol=1e-5, atol=1e-6)
+    assert a.iteration_count == b.iteration_count == 8
+    # per-step scores surface from the scan
+    assert b.last_scores.shape == (4,)
+    assert np.isclose(float(b.last_scores[-1]), b.score_value)
+
+
+def test_multi_step_listener_cadence_and_ragged_tail():
+    """10 batches at K=4: two scanned groups fire listeners at iterations 4
+    and 8; the ragged tail of 2 runs per-batch at 9 and 10."""
+    seen = []
+
+    class Recorder(IterationListener):
+        def iteration_done(self, model, iteration):
+            seen.append(iteration)
+
+    net = _mk_net()
+    net.set_listeners(Recorder())
+    net.fit(ListDataSetIterator(_batches(10)), steps_per_execution=4)
+    assert seen == [4, 8, 9, 10]
+    assert net.iteration_count == 10
+
+
+def test_multi_step_mixed_mask_group_falls_back():
+    """A group mixing masked and unmasked batches can't stack into one scan
+    pytree — it must quietly run per-batch and still train correctly."""
+    from deeplearning4j_tpu import RnnOutputLayer, GravesLSTM
+    conf = (NeuralNetConfiguration.builder().seed(5).updater(Sgd(0.1)).list()
+            .layer(GravesLSTM(n_out=8, activation="tanh"))
+            .layer(RnnOutputLayer(n_out=3, activation="softmax",
+                                  loss="MCXENT"))
+            .input_type(InputType.recurrent(4)).build())
+    rng = np.random.default_rng(1)
+    sets = []
+    for i in range(4):
+        x = rng.normal(size=(2, 6, 4)).astype(np.float32)
+        y = np.eye(3, dtype=np.float32)[rng.integers(0, 3, (2, 6))]
+        m = np.ones((2, 6), np.float32) if i % 2 else None
+        sets.append(DataSet(x, y, features_mask=m, labels_mask=m))
+    a = MultiLayerNetwork(conf).init()
+    b = MultiLayerNetwork(conf).init()
+    a.fit(ListDataSetIterator(sets))
+    b.fit(ListDataSetIterator(sets), steps_per_execution=4)
+    np.testing.assert_allclose(a.get_flat_params(), b.get_flat_params(),
+                               rtol=1e-6, atol=1e-7)
+    assert b.iteration_count == 4
+
+
+def _tbptt_conf(T_unused=None):
+    from deeplearning4j_tpu import RnnOutputLayer, GravesLSTM
+    return (NeuralNetConfiguration.builder().seed(5).updater(Sgd(0.1))
+            .list()
+            .layer(GravesLSTM(n_out=8, activation="tanh"))
+            .layer(RnnOutputLayer(n_out=3, activation="softmax",
+                                  loss="MCXENT"))
+            .input_type(InputType.recurrent(4))
+            .backprop_type("tbptt").tbptt_fwd_length(4).tbptt_back_length(4)
+            .build())
+
+
+def _tbptt_sets(T, n=4, seed=2):
+    rng = np.random.default_rng(seed)
+    sets = []
+    for _ in range(n):
+        x = rng.normal(size=(2, T, 4)).astype(np.float32)
+        y = np.eye(3, dtype=np.float32)[rng.integers(0, 3, (2, T))]
+        sets.append(DataSet(x, y))
+    return sets
+
+
+def test_multi_step_tbptt_scans_with_parity():
+    """TBPTT batches whose windows tile the sequence scan too: K batches x W
+    windows flatten into one executable with carry resets at batch
+    boundaries and a replayed rng table — params, carried-state semantics,
+    and the window-mean scores all match per-batch TBPTT."""
+    seen = []
+
+    class Recorder(IterationListener):
+        def iteration_done(self, model, iteration):
+            seen.append(iteration)
+
+    sets = _tbptt_sets(T=12)   # W = 3 windows of L=4
+    a = MultiLayerNetwork(_tbptt_conf()).init()
+    b = MultiLayerNetwork(_tbptt_conf()).init()
+    b.set_listeners(Recorder())
+    a.fit(ListDataSetIterator(sets))
+    b.fit(ListDataSetIterator(sets), steps_per_execution=2)
+    np.testing.assert_allclose(a.get_flat_params(), b.get_flat_params(),
+                               rtol=1e-6, atol=1e-7)
+    assert seen == [2, 4]          # K-step cadence, 2 groups of K=2
+    assert b.last_scores.shape == (2,)
+    # per-batch score = mean over that batch's windows == singles' score
+    a2 = MultiLayerNetwork(_tbptt_conf()).init()
+    for ds in sets:
+        a2.fit_batch(ds)
+    np.testing.assert_allclose(float(b.last_scores[-1]), a2.score_value,
+                               rtol=1e-5)
+
+
+def test_multi_step_tbptt_ragged_windows_fall_back():
+    """T=10 does not tile into L=4 windows: the group must quietly run
+    per-batch TBPTT and still match plain fit."""
+    sets = _tbptt_sets(T=10, seed=3)
+    a = MultiLayerNetwork(_tbptt_conf()).init()
+    b = MultiLayerNetwork(_tbptt_conf()).init()
+    a.fit(ListDataSetIterator(sets))
+    b.fit(ListDataSetIterator(sets), steps_per_execution=2)
+    np.testing.assert_allclose(a.get_flat_params(), b.get_flat_params(),
+                               rtol=1e-6, atol=1e-7)
+
+
+def test_multi_step_computation_graph_parity():
+    """ComputationGraph shares the mixin: scanned groups == singles."""
+    from deeplearning4j_tpu import ComputationGraph
+
+    def build():
+        conf = (NeuralNetConfiguration.builder().seed(9).updater(Adam(1e-2))
+                .graph_builder()
+                .add_inputs("in")
+                .add_layer("d", DenseLayer(n_out=16, activation="relu"), "in")
+                .add_layer("out", OutputLayer(n_out=3, activation="softmax",
+                                              loss="MCXENT"), "d")
+                .set_outputs("out")
+                .set_input_types(InputType.feed_forward(8)).build())
+        return ComputationGraph(conf).init()
+
+    sets = _batches(6)
+    a, b = build(), build()
+    a.fit(ListDataSetIterator(sets))
+    b.fit(ListDataSetIterator(sets), steps_per_execution=3)
+    for pa, pb in zip(jax.tree_util.tree_leaves(a.params),
+                      jax.tree_util.tree_leaves(b.params)):
+        np.testing.assert_allclose(np.asarray(pa), np.asarray(pb),
+                                   rtol=1e-5, atol=1e-6)
+    assert a.iteration_count == b.iteration_count == 6
+    assert b.last_scores.shape == (3,)
+
+
+def test_prepare_steps_reusable_executable():
+    """prepare_steps + fit_prepared: the bench hot path — one prepared stack
+    can run repeatedly (inputs are NOT donated) and each run advances
+    training by K steps."""
+    net = _mk_net()
+    sets = _batches(4)
+    prepared = net.prepare_steps(sets)
+    assert prepared is not None
+    s0 = None
+    for i in range(3):
+        net.fit_prepared(prepared)
+        if i == 0:
+            s0 = float(net.last_scores[-1])
+    assert net.iteration_count == 12
+    assert float(net.last_scores[-1]) < s0
