@@ -468,13 +468,24 @@ def bench_flash_attention(B=4, H=8, T=4096, D=64, K=8):
             return time.perf_counter() - t0
         return run
 
+    # masked entry (VERDICT r4 next #3 done-criterion): a ragged batch —
+    # every sequence a different valid length — through the SAME kernel;
+    # the win must survive masking, not evaporate on padded batches
+    key_mask = jnp.asarray(
+        (np.arange(T)[None, :] < np.linspace(T // 2, T, B)[:, None]),
+        jnp.float32)
+
+    def masked_fn(q, k, v, causal=True):
+        return flash_attention(q, k, v, causal=causal, key_mask=key_mask)
+
     out = {}
     for name, fn in (("flash", flash_attention),
+                     ("flash_masked", masked_fn),
                      ("reference", attention_reference),
                      ("ring_1dev", ring_fn)):
         out[name + "_ms"] = _diff_time(timed(make_scan(fn, K)),
                                        timed(make_scan(fn, 2 * K))) / K * 1e3
-        if name != "ring_1dev":
+        if name in ("flash", "reference"):
 
             def loss(q, k, v, fn=fn):
                 return jnp.sum(fn(q, k, v, causal=True).astype(jnp.float32))
@@ -486,12 +497,15 @@ def bench_flash_attention(B=4, H=8, T=4096, D=64, K=8):
     return out
 
 
-def bench_word2vec(n_pairs=65536, dim=128, vocab=10000, steps=5, n_neg=5):
-    """BASELINE #5: skip-gram negative-sampling training pairs/sec through the
-    jitted batched scatter-add kernel (reference hot loop: SkipGram.java
-    iterateSample + InMemoryLookupTable axpy updates)."""
+def bench_word2vec(n_pairs=65536, dim=128, vocab=10000, K=20, n_neg=5):
+    """BASELINE #5: skip-gram negative-sampling training pairs/sec through
+    the jitted batched scatter-add kernel (reference hot loop: SkipGram.java
+    iterateSample + InMemoryLookupTable axpy updates). K steps run inside
+    one scanned executable (the table carry makes iterations naturally
+    data-dependent), difference-timed like every other small signal."""
     import jax
     import jax.numpy as jnp
+    from jax import lax
     from deeplearning4j_tpu.nlp.embeddings import skipgram_ns_step
 
     rng = np.random.default_rng(0)
@@ -503,19 +517,31 @@ def bench_word2vec(n_pairs=65536, dim=128, vocab=10000, steps=5, n_neg=5):
     contexts = jnp.asarray(rng.integers(0, vocab, n_pairs, dtype=np.int32))
     valid = jnp.ones((n_pairs,), jnp.float32)
     key = jax.random.PRNGKey(0)
-    syn0, syn1 = skipgram_ns_step(syn0, syn1, unigram, centers, contexts,
-                                  valid, 0.025, key, n_neg)  # compile
-    _sync(syn0[0, 0])
-    state = {"syn0": syn0, "syn1": syn1, "key": key}
 
-    def run_step(i):
-        state["key"], sub = jax.random.split(state["key"])
-        state["syn0"], state["syn1"] = skipgram_ns_step(
-            state["syn0"], state["syn1"], unigram, centers, contexts, valid,
-            0.025, sub, n_neg)
+    def make(K):
+        @jax.jit
+        def run(s0, s1, k):
+            def body(c, _):
+                s0, s1, k = c
+                k, sub = jax.random.split(k)
+                s0, s1 = skipgram_ns_step(s0, s1, unigram, centers, contexts,
+                                          valid, 0.025, sub, n_neg)
+                return (s0, s1, k), ()
+            (s0, s1, k), _ = lax.scan(body, (s0, s1, k), None, length=K)
+            return s0[0, 0]
+        return run
 
-    total = _time_steps(run_step, steps, lambda: _sync(state["syn0"][0, 0]))
-    return n_pairs * steps / total
+    def timed(fn):
+        _sync(fn(syn0, syn1, key))  # compile + warm
+
+        def run():
+            t0 = time.perf_counter()
+            _sync(fn(syn0, syn1, key))
+            return time.perf_counter() - t0
+        return run
+
+    step_s = _diff_time(timed(make(K)), timed(make(2 * K))) / K
+    return n_pairs / step_s
 
 
 def _session_probe(steps=320, trials=3):
@@ -807,6 +833,8 @@ def main():
                 extras["flash_speedup"] = round(r["speedup"], 2)
                 extras["flash_temp_mb"] = round(r["flash_temp_mb"], 1)
                 extras["flash_ref_temp_mb"] = round(r["reference_temp_mb"], 1)
+                extras["flash_masked_fwdbwd_ms"] = round(
+                    r["flash_masked_ms"], 2)
                 extras["ring_1dev_fwdbwd_ms"] = round(r["ring_1dev_ms"], 2)
                 extras["ring_vs_flash"] = round(
                     r["ring_1dev_ms"] / r["flash_ms"], 2)

@@ -109,18 +109,24 @@ class MultiStepTrainable:
             listener.iteration_done(self, self.iteration_count)
         return self
 
-    def _fit_grouped(self, it, K):
+    def _fit_grouped(self, it, K, prepare=None, run=None, fallback=None):
         """One epoch: full groups of K go through the compiled scan; ragged
-        tails and incompatible groups fall back to per-batch steps."""
+        tails and incompatible groups fall back to per-batch steps. The
+        prepare/run/fallback hooks default to this model's own methods;
+        ShardedTrainer reuses the same accumulation loop with its sharded
+        prepare and mesh-scoped run."""
+        prepare = prepare or self.prepare_steps
+        run = run or (lambda prepared, group: self.fit_prepared(prepared))
+        fallback = fallback or self.fit_batch
         group = []
 
         def flush(group):
-            prepared = self.prepare_steps(group) if len(group) == K else None
+            prepared = prepare(group) if len(group) == K else None
             if prepared is not None:
-                self.fit_prepared(prepared)
+                run(prepared, group)
             else:
                 for ds in group:
-                    self.fit_batch(ds)
+                    fallback(ds)
 
         for ds in it:
             group.append(ds)

@@ -24,8 +24,16 @@ ids = (starts + np.arange(SEQ + 1)) % VOCAB     # learnable: next = cur + 1
 x = np.eye(VOCAB, dtype=np.float32)[ids[:, :-1]]
 y = np.eye(VOCAB, dtype=np.float32)[ids[:, 1:]]
 
-for step in range(30):
+for step in range(10):
     net.fit_batch(DataSet(x, y))
-    if step % 10 == 0:
+    if step % 5 == 0:
         print(f"step {step}: loss {net.score_value:.4f}")
+
+# the hot-path way: K steps per compiled executable — one host dispatch per
+# K optimizer steps (lax.scan with donated carry, nn/multistep.py); per-step
+# scores stay available on device as net.last_scores
+from deeplearning4j_tpu.datasets.iterator.base import ListDataSetIterator
+net.fit(ListDataSetIterator([DataSet(x, y)] * 20), steps_per_execution=10)
+print("scanned scores tail:",
+      [round(float(s), 4) for s in np.asarray(net.last_scores)[-3:]])
 print("final loss:", round(net.score_value, 4))

@@ -204,3 +204,53 @@ def test_prepare_steps_reusable_executable():
             s0 = float(net.last_scores[-1])
     assert net.iteration_count == 12
     assert float(net.last_scores[-1]) < s0
+
+
+def test_sharded_trainer_steps_per_execution_parity():
+    """K sharded steps inside one scanned executable (collectives inside the
+    scan) must equal K per-batch sharded steps AND K single-device steps —
+    the multi-chip hot path loses its per-step host dispatch without
+    changing semantics."""
+    from deeplearning4j_tpu.parallel.sharding import ShardedTrainer, make_mesh
+
+    sets = _batches(8, batch=32, seed=4)
+    single = _mk_net()
+    for ds in sets:
+        single.fit_batch(ds)
+
+    sharded_1 = _mk_net()
+    tr1 = ShardedTrainer(sharded_1, mesh=make_mesh(n_data=8))
+    tr1.fit(ListDataSetIterator(sets))
+    np.testing.assert_allclose(single.get_flat_params(),
+                               sharded_1.get_flat_params(),
+                               rtol=1e-5, atol=1e-6)
+
+    sharded_k = _mk_net()
+    trk = ShardedTrainer(sharded_k, mesh=make_mesh(n_data=8))
+    trk.fit(ListDataSetIterator(sets), steps_per_execution=4)
+    np.testing.assert_allclose(single.get_flat_params(),
+                               sharded_k.get_flat_params(),
+                               rtol=1e-5, atol=1e-6)
+    assert sharded_k.iteration_count == 8
+    assert sharded_k.last_scores.shape == (4,)
+
+
+def test_sharded_trainer_grouped_padding_falls_back():
+    """A group containing a batch that needs wrap-padding (not divisible by
+    the data axis) must quietly run per-batch — no example dropped, params
+    still match the single-device run."""
+    from deeplearning4j_tpu.parallel.sharding import ShardedTrainer, make_mesh
+
+    sets = _batches(4, batch=32, seed=5)
+    odd = _batches(1, batch=27, seed=6)  # 27 % 8 != 0
+    mixed = sets[:2] + odd + sets[2:]
+    single = _mk_net()
+    for ds in mixed:
+        single.fit_batch(ds)
+    sharded = _mk_net()
+    tr = ShardedTrainer(sharded, mesh=make_mesh(n_data=8))
+    tr.fit(ListDataSetIterator(mixed), steps_per_execution=5)
+    np.testing.assert_allclose(single.get_flat_params(),
+                               sharded.get_flat_params(),
+                               rtol=1e-5, atol=1e-6)
+    assert sharded.examples_fit == 32 * 4 + 27
