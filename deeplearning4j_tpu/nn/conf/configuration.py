@@ -112,6 +112,16 @@ class MultiLayerConfiguration:
     # MXU-bound forward/backward runs in bf16 (TPU-native mixed precision —
     # the reference's analog is the fp16 cuDNN bypass, ConvolutionLayer.java:158)
     compute_dtype: object = None
+    # rematerialization (gradient checkpointing): recompute activations in
+    # the backward instead of storing them (jax.checkpoint over the
+    # forward; modes in nn/remat.py). None = off; "convs_and_dots" saves
+    # conv+matmul outputs and recomputes the elementwise/BN chains (the
+    # recommended memory dial: ResNet-50 measured −24% temp for −22%
+    # throughput, PERF.md §3); "dots" saves matmul outputs only (convs
+    # recompute too); "dots_no_batch" the jax variant thereof; "full"
+    # saves only inputs. The reference has no analog (its workspace memory
+    # manager reuses buffers but never recomputes).
+    remat: object = None
     optimization_algo: str = OptimizationAlgorithm.STOCHASTIC_GRADIENT_DESCENT
     max_num_line_search_iterations: int = 5
     pretrain: bool = False
@@ -131,6 +141,7 @@ class MultiLayerConfiguration:
             "seed": self.seed,
             "dtype": self.dtype,
             "compute_dtype": self.compute_dtype,
+            "remat": self.remat,
             "optimization_algo": self.optimization_algo,
             "max_num_line_search_iterations": self.max_num_line_search_iterations,
             "pretrain": self.pretrain,
@@ -149,7 +160,7 @@ class MultiLayerConfiguration:
         it = d.get("input_type")
         conf.input_type = InputType.from_dict(it) if it else None
         for k in ("backprop_type", "tbptt_fwd_length", "tbptt_back_length", "seed",
-                  "dtype", "compute_dtype", "optimization_algo",
+                  "dtype", "compute_dtype", "remat", "optimization_algo",
                   "max_num_line_search_iterations", "pretrain", "backprop"):
             if k in d:
                 setattr(conf, k, d[k])
@@ -228,6 +239,7 @@ class ListBuilder:
             seed=g.get("seed", 12345),
             dtype=g.get("dtype", "float32"),
             compute_dtype=g.get("compute_dtype"),
+            remat=g.get("remat"),
             optimization_algo=g.get("optimization_algo",
                                     OptimizationAlgorithm.STOCHASTIC_GRADIENT_DESCENT),
             max_num_line_search_iterations=g.get("max_num_line_search_iterations", 5),
@@ -331,6 +343,13 @@ class NeuralNetConfigurationBuilder:
 
     def dtype(self, dt):
         self._g["dtype"] = str(dt)
+        return self
+
+    def remat(self, mode):
+        """Rematerialization: None / "convs_and_dots" (recommended memory
+        dial) / "dots" / "dots_no_batch" / "full" — see
+        MultiLayerConfiguration.remat and nn/remat.py."""
+        self._g["remat"] = mode
         return self
 
     def compute_dtype(self, dt):

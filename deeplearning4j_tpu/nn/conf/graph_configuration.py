@@ -310,6 +310,7 @@ class ComputationGraphConfiguration:
     seed: int = 12345
     dtype: str = "float32"
     compute_dtype: object = None   # mixed precision (see MultiLayerConfiguration)
+    remat: object = None           # rematerialization (see MultiLayerConfiguration)
     optimization_algo: str = "sgd"
     max_num_line_search_iterations: int = 5
     topological_order: list = None
@@ -360,6 +361,7 @@ class ComputationGraphConfiguration:
             "seed": self.seed,
             "dtype": self.dtype,
             "compute_dtype": self.compute_dtype,
+            "remat": self.remat,
             "optimization_algo": self.optimization_algo,
             "max_num_line_search_iterations": self.max_num_line_search_iterations,
         }
@@ -382,7 +384,7 @@ class ComputationGraphConfiguration:
         if d.get("input_types"):
             conf.input_types = [InputType.from_dict(t) for t in d["input_types"]]
         for k in ("backprop_type", "tbptt_fwd_length", "tbptt_back_length", "seed",
-                  "dtype", "compute_dtype", "optimization_algo",
+                  "dtype", "compute_dtype", "remat", "optimization_algo",
                   "max_num_line_search_iterations"):
             if k in d:
                 setattr(conf, k, d[k])
@@ -402,6 +404,7 @@ class GraphBuilder:
             seed=global_conf.get("seed", 12345),
             dtype=global_conf.get("dtype", "float32"),
             compute_dtype=global_conf.get("compute_dtype"),
+            remat=global_conf.get("remat"),
             optimization_algo=global_conf.get("optimization_algo", "sgd"),
             max_num_line_search_iterations=global_conf.get(
                 "max_num_line_search_iterations", 5))

@@ -195,10 +195,17 @@ class ComputationGraph(MultiStepTrainable):
         else:
             fwd_rng = None
         # run everything except output layers' score; output layer forward is
-        # replaced by its integrated loss on the features feeding it.
-        acts, new_states, out_masks, carries = self._forward(
-            params, states, inputs, train=train, rng=fwd_rng, masks=masks,
-            initial_carries=initial_carries)
+        # replaced by its integrated loss on the features feeding it. Under
+        # conf.remat the forward recomputes (policy-chosen) activations in
+        # the backward instead of storing them (nn/remat.py) — training only
+        def fwd_fn(p, s, xx, rr, mm, ic):
+            return self._forward(p, s, xx, train=train, rng=rr, masks=mm,
+                                 initial_carries=ic)
+        from ..remat import maybe_checkpoint
+        fwd_fn = maybe_checkpoint(
+            fwd_fn, getattr(conf, "remat", None) if train else None)
+        acts, new_states, out_masks, carries = fwd_fn(
+            params, states, inputs, fwd_rng, masks, initial_carries)
         total = 0.0
         lm = label_masks or [None] * len(conf.network_outputs)
         for out_name, y, mlab in zip(conf.network_outputs, labels, lm):

@@ -185,9 +185,17 @@ class MultiLayerNetwork(MultiStepTrainable):
             rng, fwd_rng, pre_rng = jax.random.split(rng, 3)
         else:
             fwd_rng = pre_rng = None
-        feats, new_states, cur_mask, carries, _ = self._forward(
-            params, states, x, train=train, rng=fwd_rng, mask=mask, to_layer=out_idx,
-            initial_carries=initial_carries)
+        # conf.remat recomputes (policy-chosen) activations in the backward
+        # instead of storing them (nn/remat.py) — training only
+
+        def fwd_fn(p, s, xx, rr, mm, ic):
+            return self._forward(p, s, xx, train=train, rng=rr, mask=mm,
+                                 to_layer=out_idx, initial_carries=ic)
+        from ..remat import maybe_checkpoint
+        fwd_fn = maybe_checkpoint(
+            fwd_fn, getattr(self.conf, "remat", None) if train else None)
+        feats, new_states, cur_mask, carries, _ = fwd_fn(
+            params, states, x, fwd_rng, mask, initial_carries)
         out_layer = self.layers[out_idx]
         feats, cur_mask = self._apply_preprocessor(out_idx, feats, cur_mask,
                                                    rng=pre_rng)

@@ -1,0 +1,55 @@
+"""Rematerialization (gradient checkpointing) for the training forward.
+
+The ResNet-50 headline step is HBM-bound with the MXU idle ~70% of the step
+(PERF.md): the roofline-correct optimization is to SPEND idle FLOPs to move
+fewer bytes. `jax.checkpoint` over the forward does exactly that — saved
+residuals (activation stores + backward re-reads) disappear in exchange for
+recomputing them from cheaper-to-save values during the backward.
+
+Policies (MultiLayerConfiguration.remat / GraphBuilder global conf):
+  "convs_and_dots" — save conv and matmul OUTPUTS, recompute every
+      elementwise/BN/padding chain in the backward. For conv+BN training
+      this deletes the stored copies of the normalize/ReLU chains — the
+      same byte reduction PERF.md r4 estimated for a hand-fused conv+BN
+      Pallas epilogue (~25%), obtained from the autodiff system instead of
+      a kernel rewrite.
+  "dots" — jax.checkpoint_policies.checkpoint_dots: save matmul-class
+      outputs only; convs recompute too (doubles conv forward FLOPs).
+  "full" — save only the forward's inputs; recompute everything.
+
+The reference has no analog: its workspace memory manager
+(nd4j workspaces) reuses buffers but never trades compute for memory.
+"""
+from __future__ import annotations
+
+import jax
+
+
+def _convs_and_dots_saveable(prim, *_, **__):
+    return prim.name in ("conv_general_dilated", "dot_general")
+
+
+def _policies():
+    cp = jax.checkpoint_policies
+    return {
+        "full": None,
+        "dots": cp.checkpoint_dots,
+        "dots_no_batch": cp.checkpoint_dots_with_no_batch_dims,
+        "convs_and_dots": _convs_and_dots_saveable,
+    }
+
+
+def maybe_checkpoint(fn, mode):
+    """Wrap `fn` in jax.checkpoint under the named policy; identity when
+    mode is falsy. Unknown modes fail loudly (a typo silently training
+    without remat would be a perf heisenbug)."""
+    if not mode:
+        return fn
+    policies = _policies()
+    if mode not in policies:
+        raise ValueError(f"unknown remat mode {mode!r}; "
+                         f"one of {sorted(policies)}")
+    policy = policies[mode]
+    if policy is None:
+        return jax.checkpoint(fn)
+    return jax.checkpoint(fn, policy=policy)

@@ -138,16 +138,19 @@ def _resnet_conv_block(gb, name, n_in_name, filters, stride, bottleneck=True,
 
 
 def resnet50(num_classes=1000, image_size=224, seed=12345, updater=None,
-             compute_dtype=None):
+             compute_dtype=None, remat=None):
     """ResNet-50 as a ComputationGraph (BASELINE config #2). Structure follows
     the standard [3,4,6,3] bottleneck stacking; built from the same layer/vertex
     vocabulary the reference exposes (ConvolutionLayer, BatchNormalization,
     ElementWiseVertex add = residual). compute_dtype="bfloat16" enables
-    TPU mixed precision (f32 params/BN stats/loss, bf16 conv+matmul)."""
+    TPU mixed precision (f32 params/BN stats/loss, bf16 conv+matmul);
+    remat="convs_and_dots" recomputes the BN/ReLU/residual chains in the
+    backward instead of storing them (nn/remat.py)."""
     gb = (NeuralNetConfiguration.builder()
           .seed(seed).updater(updater or Nesterovs(learning_rate=0.1, momentum=0.9))
           .weight_init("relu")
           .compute_dtype(compute_dtype)
+          .remat(remat)
           .graph_builder()
           .add_inputs("in"))
     gb.add_layer("stem_conv", ConvolutionLayer(kernel_size=(7, 7), stride=(2, 2),

@@ -1,0 +1,115 @@
+"""Rematerialization (conf.remat, nn/remat.py): policy-driven
+jax.checkpoint over the training forward must change MEMORY/compute
+trade-offs only — never the math. Parity oracle: the identical config
+without remat."""
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu import (NeuralNetConfiguration, InputType,
+                                ConvolutionLayer, SubsamplingLayer,
+                                BatchNormalization, DenseLayer, OutputLayer,
+                                MultiLayerNetwork, DataSet, Adam)
+
+
+def _build(remat, dropout=None):
+    conf = (NeuralNetConfiguration.builder().seed(3).updater(Adam(1e-2))
+            .remat(remat).list()
+            .layer(ConvolutionLayer(kernel_size=(3, 3), n_out=8,
+                                    activation="relu", padding=(1, 1),
+                                    dropout=dropout))
+            .layer(BatchNormalization())
+            .layer(SubsamplingLayer(kernel_size=(2, 2), stride=(2, 2)))
+            .layer(DenseLayer(n_out=16, activation="relu"))
+            .layer(OutputLayer(n_out=3, activation="softmax", loss="MCXENT"))
+            .input_type(InputType.convolutional(8, 8, 3)).build())
+    return MultiLayerNetwork(conf).init()
+
+
+def _data(seed=0):
+    rng = np.random.default_rng(seed)
+    x = rng.normal(size=(16, 8, 8, 3)).astype(np.float32)
+    y = np.eye(3, dtype=np.float32)[rng.integers(0, 3, 16)]
+    return DataSet(x, y)
+
+
+@pytest.mark.parametrize("mode", ["convs_and_dots", "dots", "full"])
+def test_remat_training_matches_no_remat(mode):
+    """Every policy trains bit-compatibly with the un-checkpointed config
+    (recompute re-runs the same ops): params, BN running stats, scores."""
+    ds = _data()
+    base, net = _build(None), _build(mode)
+    assert net.conf.remat == mode  # builder threads the flag through
+    for _ in range(4):
+        base.fit_batch(ds)
+        net.fit_batch(ds)
+    np.testing.assert_allclose(base.get_flat_params(), net.get_flat_params(),
+                               rtol=1e-5, atol=1e-6)
+    import jax
+    for sa, sb in zip(jax.tree_util.tree_leaves(base.states),
+                      jax.tree_util.tree_leaves(net.states)):
+        np.testing.assert_allclose(np.asarray(sa), np.asarray(sb),
+                                   rtol=1e-5, atol=1e-6)
+    np.testing.assert_allclose(base.score_value, net.score_value, rtol=1e-5)
+
+
+def test_remat_with_dropout_rng_consistency():
+    """The checkpointed forward replays with the SAME rng during the
+    backward recompute — dropout masks must not diverge between the two
+    passes (params would silently drift if they did)."""
+    ds = _data(1)
+    base, net = _build(None, dropout=0.3), _build("full", dropout=0.3)
+    for _ in range(4):
+        base.fit_batch(ds)
+        net.fit_batch(ds)
+    np.testing.assert_allclose(base.get_flat_params(), net.get_flat_params(),
+                               rtol=1e-5, atol=1e-6)
+
+
+def test_remat_graph_and_multistep():
+    """ComputationGraph remat (via the graph builder global conf) + the
+    scanned K-step path compose: grouped training equals per-batch."""
+    from deeplearning4j_tpu import ComputationGraph, ListDataSetIterator
+
+    def build():
+        conf = (NeuralNetConfiguration.builder().seed(9).updater(Adam(1e-2))
+                .remat("convs_and_dots")
+                .graph_builder()
+                .add_inputs("in")
+                .add_layer("c", ConvolutionLayer(kernel_size=(3, 3), n_out=4,
+                                                 activation="relu",
+                                                 convolution_mode="same"), "in")
+                .add_layer("d", DenseLayer(n_out=16, activation="relu"), "c")
+                .add_layer("out", OutputLayer(n_out=3, activation="softmax",
+                                              loss="MCXENT"), "d")
+                .set_outputs("out")
+                .set_input_types(InputType.convolutional(8, 8, 3)).build())
+        assert conf.remat == "convs_and_dots"
+        return ComputationGraph(conf).init()
+
+    rng = np.random.default_rng(2)
+    sets = []
+    for _ in range(4):
+        x = rng.normal(size=(8, 8, 8, 3)).astype(np.float32)
+        y = np.eye(3, dtype=np.float32)[rng.integers(0, 3, 8)]
+        sets.append(DataSet(x, y))
+    a, b = build(), build()
+    for ds in sets:
+        a.fit_batch(ds)
+    b.fit(ListDataSetIterator(sets), steps_per_execution=4)
+    import jax
+    for pa, pb in zip(jax.tree_util.tree_leaves(a.params),
+                      jax.tree_util.tree_leaves(b.params)):
+        np.testing.assert_allclose(np.asarray(pa), np.asarray(pb),
+                                   rtol=1e-5, atol=1e-6)
+
+
+def test_remat_unknown_mode_fails_loudly():
+    net = _build("typo_mode")
+    with pytest.raises(ValueError, match="unknown remat mode"):
+        net.fit_batch(_data())
+
+
+def test_remat_serde_round_trip():
+    from deeplearning4j_tpu.nn.conf.configuration import MultiLayerConfiguration
+    d = _build("convs_and_dots").conf.to_dict()
+    assert MultiLayerConfiguration.from_dict(d).remat == "convs_and_dots"
