@@ -30,9 +30,12 @@ Roofline methodology (PERF.md carries the full dossier):
 
 Timing methodology (remote-attached TPU): dispatch is async and
 block_until_ready can be a no-op through the PJRT relay, so the only
-trustworthy fence is a device->host readback; K steps are bracketed by
-readbacks and the readback latency floor is subtracted. The train step itself
-never syncs (score stays on device).
+trustworthy fence is a device->host readback. Small signals are
+DIFFERENCE-TIMED (`_diff_time`: interleaved K- vs 2K-deep executables,
+min-vs-min, outage self-check) so the 60-110 ms bimodal per-call floor
+cancels instead of being subtracted with error; only the long-signal
+ResNet loop still uses plain fenced timing. The train step itself never
+syncs (score stays on device).
 
 Round-5 hardening (VERDICT r4 "what's weak" #1/#3): the training benches run
 the loop INSIDE one executable — `fit(steps_per_execution=K)` compiles K
@@ -101,25 +104,47 @@ def _diff_time(run_k, run_2k, trials=5):
     """Floor-FREE seconds for K extra iterations, robust to the relay's
     BIMODAL per-call floor. Measured behavior of this rig: each invocation
     pays a constant dispatch+readback cost that jumps call-to-call between
-    ~60 and ~105 ms with no pattern — so neither subtracting a separately
-    measured floor (r04: ± several ms error, 5x LeNet swings) nor simple
-    pairing (one odd call corrupts its pair) is safe. Instead: collect
-    `trials` interleaved samples of each depth and take the MEDIAN over ALL
-    cross differences t(2K)_j − t(K)_i (Theil-Sen slope for two depths).
-    The floor difference across samples is symmetrically distributed around
-    zero whatever its two modes are, so its median vanishes and the median
-    cross-difference estimates the pure K-step signal."""
-    t1s, t2s = [], []
-    for _ in range(trials):
-        t1s.append(run_k())
-        t2s.append(run_2k())
-    diffs = sorted(b - a for a in t1s for b in t2s)
-    return max(diffs[len(diffs) // 2], 1e-9)
+    ~60 and ~105 ms with no pattern — so subtracting a separately measured
+    floor (r04: ± several ms error, 5x LeNet swings) is unsafe, and so is
+    any mean/median-of-differences scheme (an unbalanced draw of floor
+    modes between the two depth groups shifts the median by a whole mode
+    gap). Estimator: INTERLEAVE the K- and 2K-deep runs so both groups
+    sample the same floor phases, then take min(t_2K) − min(t_K) — each
+    min converges to signal·depth + the SAME lowest floor, which cancels
+    exactly whatever the floor distribution is, needing only one low-floor
+    sample per group (p ≈ 1 − 2^−trials per mode).
+
+    Self-check: under the model t = signal*depth + floor with floor >= 0,
+    the true difference can never exceed half of min(t_2K); an estimate
+    violating that means a multi-second relay outage swallowed one whole
+    sample group (observed in the wild) — resample up to twice before
+    accepting the least-bad round."""
+    positives = []
+    for _ in range(3):
+        t1s, t2s = [], []
+        for _ in range(trials):
+            t1s.append(run_k())
+            t2s.append(run_2k())
+        est = min(t2s) - min(t1s)
+        if 0 < est <= 0.55 * min(t2s):
+            return est
+        if est > 0:
+            positives.append(est)
+    if positives:
+        return min(positives)   # least-bad round that at least went forward
+    # every round inverted (K-group outages): no defensible number exists —
+    # surface the failure instead of publishing signal/1e-9 absurdities
+    raise RuntimeError("_diff_time: relay outages corrupted all sample "
+                       "rounds; measurement aborted")
 
 
-def _scanned_fit_step_s(net, ds, K, trials=3):
+def _scanned_fit_step_s(net, ds, K, trials=5):
     """Per-train-step seconds via two scanned executions (K and 2K steps
-    inside one executable each; see nn/multistep.py), difference-timed."""
+    inside one executable each; see nn/multistep.py), difference-timed.
+    trials=5 keeps the chance that one depth group never samples the low
+    floor mode (biasing the min-difference by a mode gap) under ~6% even
+    for adversarially i.i.d. floors; on the real rig modes persist for
+    many calls, making a within-window miss rarer still."""
     p1 = net.prepare_steps([ds] * K)
     p2 = net.prepare_steps([ds] * (2 * K))
     net.fit_prepared(p1)
@@ -315,7 +340,7 @@ def bench_resnet50_end_to_end(compute_step_ms, batch=256, image=224,
     return e2e_sps, h2d_mb_s, link_ms, wall_ms, overlap
 
 
-def bench_lenet(batch=128, K=400, trials=3):
+def bench_lenet(batch=128, K=400, trials=5):
     """BASELINE #1, via the compiled K-step loop (one executable per K train
     steps) with difference timing, so neither the relay's per-dispatch phase
     nor the readback floor touches the number."""
@@ -363,7 +388,7 @@ def bench_real32_accuracy(epochs=10):
     return real32_gate_accuracy(epochs=epochs)
 
 
-def bench_char_rnn(batch=64, seq=200, vocab=80, steps=20, trials=3):
+def bench_char_rnn(batch=64, seq=200, vocab=80, steps=20, trials=5):
     """BASELINE #3: GravesLSTM char-RNN TBPTT training throughput
     (chars/sec; the reference hot loop is LSTMHelpers.java:172-174 per-step
     gemms — here one lax.scan over fused gemms). The K batches x 4 TBPTT
@@ -391,7 +416,7 @@ def bench_char_rnn(batch=64, seq=200, vocab=80, steps=20, trials=3):
     return batch * seq / step_s
 
 
-def bench_transformer_lm(batch=16, seq=512, vocab=256, steps=10, trials=3):
+def bench_transformer_lm(batch=16, seq=512, vocab=256, steps=10, trials=5):
     """Flagship-adjacent transformer LM: tokens/sec through the full
     ComputationGraph train step (4 layers, d_model 256, 4 heads, causal,
     Pallas flash attention, bf16 compute), all `steps` steps inside one
@@ -544,7 +569,7 @@ def bench_word2vec(n_pairs=65536, dim=128, vocab=10000, K=20, n_neg=5):
     return n_pairs / step_s
 
 
-def _session_probe(steps=320, trials=3):
+def _session_probe(steps=320, trials=5):
     """Fixed-size health probe: per-step ms of a FIXED MLP train step (batch
     512, hidden 2048 — ~11 GFLOP/step, ≈0.2 ms on a healthy v5e, so the
     K-vs-2K difference signal is tens of ms, well above pair noise) run
