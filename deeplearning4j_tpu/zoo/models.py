@@ -183,18 +183,22 @@ def resnet50(num_classes=1000, image_size=224, seed=12345, updater=None,
 
 def transformer_lm(vocab_size=256, d_model=256, n_layers=4, n_heads=4,
                    ffn_mult=4, seed=12345, causal=True, use_pallas=False,
-                   compute_dtype=None, updater=None):
+                   compute_dtype=None, updater=None, remat=None):
     """Decoder-only transformer language model — NEW model family beyond the
     reference's 2017 zoo (no attention exists in DL4J v0.7.3; SURVEY.md §5
     names long-context attention as this framework's new capability). Built
     from the same DSL vocabulary as everything else: SelfAttentionLayer
     (optionally the Pallas flash kernel), LayerNormalization (post-norm),
     per-timestep Dense FFN, ElementWiseVertex residuals. Input: one-hot
-    [b, t, vocab]; output: next-token softmax per position."""
+    [b, t, vocab]; output: next-token softmax per position.
+    remat="dots" is the long-context memory dial: saved activations scale
+    with n_layers*T*d_model, and recomputing the LN/residual/softmax chains
+    in the backward trades idle MXU time for that memory (nn/remat.py)."""
     from ..nn.conf.layers import LayerNormalization, SelfAttentionLayer
     gb = (NeuralNetConfiguration.builder()
           .seed(seed).updater(updater or Adam(3e-4)).weight_init("xavier")
           .compute_dtype(compute_dtype)
+          .remat(remat)
           .graph_builder()
           .add_inputs("tokens"))
     gb.add_layer("embed", DenseLayer(n_out=d_model, activation="identity"),
