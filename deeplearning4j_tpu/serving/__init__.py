@@ -1,0 +1,30 @@
+"""Production serving subsystem.
+
+Three cooperating pieces in front of the jitted `model.output` hot path:
+
+- `DynamicBatcher` — coalesces concurrent requests into padded power-of-two
+  shape buckets (bounded wait `max_latency_ms`), so steady-state serving
+  compiles at most one XLA executable per bucket and zero thereafter.
+- `ModelRegistry` — versioned ModelSerializer-zip loading with atomic
+  hot-swap: `deploy` warm-compiles the incoming version on every observed
+  bucket while the old version keeps serving, then swaps the pointer;
+  `rollback` redeploys the previous version. Per-version serve counts.
+- `AdmissionQueue` — bounded queue with per-request deadlines; a full queue
+  sheds immediately (HTTP 429 + Retry-After) instead of queueing unbounded
+  latency, and shutdown drains gracefully.
+
+`ServingServer` is the HTTP front-end (/predict, /models, /deploy,
+/rollback, /metrics, /healthz) on the shared util/http plumbing; metrics
+route into the ui/storage stats tier. The legacy
+`streaming.InferenceServer` is now a thin compatibility wrapper over it.
+"""
+from .admission import (AdmissionQueue, DeadlineExceeded, RejectedError,
+                        Request)
+from .batcher import DynamicBatcher, bucket_for
+from .metrics import ServingMetrics
+from .registry import ModelRegistry, ModelVersion, NoModelDeployed
+from .server import ServingServer
+
+__all__ = ["AdmissionQueue", "DeadlineExceeded", "RejectedError", "Request",
+           "DynamicBatcher", "bucket_for", "ServingMetrics", "ModelRegistry",
+           "ModelVersion", "NoModelDeployed", "ServingServer"]

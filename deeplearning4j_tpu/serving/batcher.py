@@ -1,0 +1,133 @@
+"""Dynamic micro-batcher: coalesce concurrent requests into padded
+power-of-two batches so steady-state serving never recompiles.
+
+Why buckets: `model.output` is jitted, and XLA compiles one executable per
+input shape — serving raw request sizes (1, 3, 7, ...) would recompile on
+every odd shape (cf. the fixed-primitive batching argument in PAPERS.md).
+Padding the coalesced batch's leading dim up to the next power of two bounds
+the executable set to log2(max_batch_size)+1 per feature signature; the pad
+rows are zeros and are sliced off before results are returned, and each
+caller's rows are bitwise-identical to a direct `model.output` call on the
+same executable family.
+
+One batcher thread owns dispatch: it takes a coalesced batch from the
+AdmissionQueue (bounded wait `max_latency_ms` after the first request),
+reads ONE `(version, model)` snapshot from the registry — so a hot-swap can
+never mix versions within a batch — runs the jitted forward, splits the
+output back to per-request futures, and records metrics.
+"""
+from __future__ import annotations
+
+import threading
+import time
+
+import numpy as np
+
+
+def bucket_for(rows):
+    """Smallest power of two >= rows."""
+    b = 1
+    while b < rows:
+        b <<= 1
+    return b
+
+
+class DynamicBatcher:
+    def __init__(self, registry, queue, metrics, max_batch_size=32,
+                 max_latency_ms=5.0):
+        self.registry = registry
+        self.queue = queue
+        self.metrics = metrics
+        self.max_batch_size = bucket_for(int(max_batch_size))
+        self.max_latency_ms = float(max_latency_ms)
+        self.observed = set()         # (signature, bucket) pairs dispatched
+        self._obs_lock = threading.Lock()
+        self._thread = None
+
+    # ---- lifecycle --------------------------------------------------------
+    def start(self):
+        if self._thread is not None and self._thread.is_alive():
+            return self            # one batcher thread owns dispatch
+        self._thread = threading.Thread(target=self._run, daemon=True,
+                                        name="serving-batcher")
+        self._thread.start()
+        return self
+
+    def _run(self):
+        while True:
+            batch = self.queue.take_batch(self.max_batch_size,
+                                          self.max_latency_ms / 1000.0)
+            if batch is None:          # queue closed and fully drained
+                break
+            try:
+                self._dispatch(batch)
+            except Exception as e:     # last-resort: the loop must survive
+                self.metrics.errors.add(len(batch))
+                for r in batch:
+                    r.fail(e)          # real cause, not a generic wrapper
+
+    def join(self, timeout=None):
+        """Wait until the queue is drained and the batcher thread exited.
+        The thread exits only after take_batch returns None (closed + empty),
+        so a plain join IS the drained barrier — bounded by `timeout` once,
+        not twice."""
+        if self._thread is not None:
+            self._thread.join(timeout)
+
+    # ---- dispatch ---------------------------------------------------------
+    def _dispatch(self, batch):
+        # drop requests already completed elsewhere (client cancel, chunk
+        # sibling failure): dispatching them would burn compute and count
+        # rows the caller will never receive
+        batch = [r for r in batch if not r.future.done()]
+        if not batch:
+            return
+        # everything up to the split is inside the try: a failure (no model
+        # deployed, bad input, model error) must fail THIS batch's futures,
+        # never escape and kill the batcher thread
+        try:
+            version, model = self.registry.active()
+            rows = sum(r.rows for r in batch)
+            bucket = bucket_for(rows)
+            x = batch[0].x if len(batch) == 1 else \
+                np.concatenate([r.x for r in batch], axis=0)
+            if bucket > rows:
+                pad = np.zeros((bucket - rows,) + x.shape[1:], dtype=x.dtype)
+                x = np.concatenate([x, pad], axis=0)
+            out = np.asarray(model.output(x))
+        except Exception as e:
+            self.metrics.errors.add(len(batch))
+            for r in batch:
+                r.fail(e)
+            return
+        # record AFTER success: a malformed request (e.g. wrong feature
+        # count) must not poison every future deploy/rollback warm-up
+        with self._obs_lock:
+            self.observed.add((batch[0].signature, bucket))
+        self.registry.count_served(version, rows)
+        self.metrics.record_batch(
+            bucket, sum(1 for r in batch if r.count_as_request), rows)
+        now = time.monotonic()
+        offset = 0
+        for r in batch:
+            r.complete({"prediction": out[offset:offset + r.rows],
+                        "version": version})
+            self.metrics.record_latency((now - r.enqueued_at) * 1000.0)
+            offset += r.rows
+
+    def reset_observed(self):
+        """Forget recorded (signature, bucket) pairs — used when the serving
+        model's input contract changes and the old shapes no longer apply."""
+        with self._obs_lock:
+            self.observed.clear()
+
+    # ---- warm-up (used by registry deploy/rollback) ------------------------
+    def warmup(self, model):
+        """Compile `model`'s executables for every (signature, bucket) this
+        batcher has dispatched, so a hot-swapped version is never cold."""
+        with self._obs_lock:
+            observed = sorted(self.observed,
+                              key=lambda sb: (str(sb[0]), sb[1]))
+        for (shape, dtype), bucket in observed:
+            zeros = np.zeros((bucket,) + tuple(shape), dtype=dtype)
+            np.asarray(model.output(zeros))   # block until compiled + run

@@ -1,0 +1,92 @@
+"""Serving metrics: latency percentiles, batch-size histogram, queue depth,
+shed/expiry counts.
+
+All mutation goes through AtomicCounter or the reservoir lock so concurrent
+HTTP handler threads and the batcher thread never race (the seed
+InferenceServer's bare `self.served += n` was a lost-update race). Snapshots
+are plain JSON dicts; `flush_to_router` routes them into the existing
+ui/storage StatsStorageRouter tier so a UI server can tail a live serving
+process exactly like a training run.
+"""
+from __future__ import annotations
+
+import threading
+import time
+
+from ..util.concurrency import AtomicCounter
+
+
+class ServingMetrics:
+    RESERVOIR = 4096  # most-recent latency samples kept for percentiles
+
+    def __init__(self, session_id="serving"):
+        self.session_id = session_id
+        self.requests = AtomicCounter()       # requests answered OK
+        self.rows = AtomicCounter()           # example rows answered OK
+        self.batches = AtomicCounter()        # batches dispatched
+        self.shed = AtomicCounter()           # rejected: queue full (429)
+        self.expired = AtomicCounter()        # rejected: deadline passed
+        self.errors = AtomicCounter()         # failed in model dispatch
+        self._lock = threading.Lock()
+        self._latencies_ms = []               # ring buffer, RESERVOIR cap
+        self._batch_hist = {}                 # padded batch size -> count
+
+    # ---- recording (batcher + handlers) -----------------------------------
+    def record_batch(self, bucket_rows, n_requests, n_rows):
+        self.batches.add(1)
+        self.requests.add(n_requests)
+        self.rows.add(n_rows)
+        with self._lock:
+            self._batch_hist[bucket_rows] = \
+                self._batch_hist.get(bucket_rows, 0) + 1
+
+    def record_latency(self, ms):
+        with self._lock:
+            self._latencies_ms.append(float(ms))
+            if len(self._latencies_ms) > self.RESERVOIR:
+                del self._latencies_ms[:len(self._latencies_ms)
+                                       - self.RESERVOIR]
+
+    # ---- reading ----------------------------------------------------------
+    @staticmethod
+    def _percentile(sorted_vals, q):
+        if not sorted_vals:
+            return None
+        idx = min(len(sorted_vals) - 1, int(round(q * (len(sorted_vals) - 1))))
+        return sorted_vals[idx]
+
+    def snapshot(self, queue_depth=None, version_rows=None):
+        """`version_rows` comes from the registry's per-version serve counts
+        (the single source of truth) rather than a second counter here."""
+        with self._lock:
+            lat = sorted(self._latencies_ms)
+            batch_hist = dict(self._batch_hist)
+        return {
+            "requests": self.requests.get(),
+            "rows": self.rows.get(),
+            "batches": self.batches.get(),
+            "shed": self.shed.get(),
+            "expired": self.expired.get(),
+            "errors": self.errors.get(),
+            "queue_depth": queue_depth,
+            "batch_size_histogram": {str(k): v
+                                     for k, v in sorted(batch_hist.items())},
+            "version_rows": version_rows or {},
+            "latency_ms": {
+                "count": len(lat),
+                "p50": self._percentile(lat, 0.50),
+                "p95": self._percentile(lat, 0.95),
+                "p99": self._percentile(lat, 0.99),
+                "max": lat[-1] if lat else None,
+            },
+        }
+
+    def flush_to_router(self, router, queue_depth=None, snapshot=None):
+        """Post a snapshot (or a caller-provided one) into a ui/storage
+        StatsStorageRouter."""
+        from ..ui.stats import ServingStatsReport
+        if snapshot is None:
+            snapshot = self.snapshot(queue_depth=queue_depth)
+        report = ServingStatsReport(self.session_id, snapshot)
+        router.put_update(report)
+        return report

@@ -1,0 +1,160 @@
+"""Versioned model registry with atomic hot-swap.
+
+Reference seam: util/ModelSerializer (zip checkpoints) + ModelGuesser type
+sniffing. A version is registered (in-memory model or loaded from a
+ModelSerializer zip), then `deploy`d: the warm-up callable runs the NEW
+model's inference on every observed (bucket, feature-shape) so its XLA
+executables are compiled BEFORE the atomic pointer swap — the old version
+keeps serving the whole time, and in-flight batches dispatched against the
+old snapshot complete on it (the batcher reads `(version, model)` once per
+batch, so a batch never mixes versions). `rollback` redeploys the previous
+active version the same way.
+"""
+from __future__ import annotations
+
+import threading
+import time
+
+from ..util.concurrency import AtomicCounter
+from ..util.model_serializer import ModelSerializer
+
+
+class NoModelDeployed(RuntimeError):
+    """Serving was asked for a model before any version was deployed — a
+    server-side condition (HTTP 503), not a client error."""
+
+
+class ModelVersion:
+    def __init__(self, version, model, path=None, fmt=None):
+        self.version = str(version)
+        self.model = model
+        self.path = str(path) if path is not None else None
+        self.fmt = fmt                       # zip format.json, when file-backed
+        self.loaded_at = time.time()
+        self.deployed_at = None
+        self.serve_count = AtomicCounter()   # rows served by this version
+
+    def info(self, active_version=None):
+        return {
+            "version": self.version,
+            "model_class": type(self.model).__name__,
+            "path": self.path,
+            "format": self.fmt,
+            "loaded_at": self.loaded_at,
+            "deployed_at": self.deployed_at,
+            "serve_count": self.serve_count.get(),
+            "active": self.version == active_version,
+        }
+
+
+class ModelRegistry:
+    def __init__(self):
+        self._versions = {}
+        self._active = None           # version string
+        self._history = []            # previously active versions, for rollback
+        self._lock = threading.Lock()
+        self._deploy_lock = threading.Lock()  # serializes deploy/rollback
+
+    # ---- registration -----------------------------------------------------
+    def register(self, version, model, path=None, fmt=None):
+        with self._lock:
+            if str(version) in self._versions:
+                raise ValueError(f"version {version!r} already registered")
+            self._versions[str(version)] = ModelVersion(version, model, path,
+                                                        fmt)
+        return str(version)
+
+    def unregister(self, version):
+        """Remove a non-active version (e.g. roll back a registration whose
+        deploy warm-up failed, so the same /deploy request can be retried)."""
+        version = str(version)
+        with self._lock:
+            if version == self._active:
+                raise ValueError(f"version {version!r} is active")
+            self._versions.pop(version, None)
+            self._history = [v for v in self._history if v != version]
+
+    def load(self, version, path):
+        """Load a ModelSerializer zip (type-sniffed) and register it with the
+        zip's format metadata (model class, dtype, framework)."""
+        fmt = ModelSerializer.read_format(path)
+        model = ModelSerializer.restore(path, load_updater=False)
+        return self.register(version, model, path=path, fmt=fmt)
+
+    # ---- serving-side reads ------------------------------------------------
+    def active(self):
+        """One consistent (version, model) snapshot for a batch dispatch."""
+        with self._lock:
+            if self._active is None:
+                raise NoModelDeployed("no model deployed")
+            return self._active, self._versions[self._active].model
+
+    @property
+    def active_version(self):
+        with self._lock:
+            return self._active
+
+    def count_served(self, version, n_rows):
+        with self._lock:
+            mv = self._versions.get(version)
+        if mv is not None:
+            mv.serve_count.add(n_rows)
+
+    def versions(self):
+        with self._lock:
+            active = self._active
+            return [mv.info(active) for mv in self._versions.values()]
+
+    def get(self, version):
+        with self._lock:
+            return self._versions[str(version)]
+
+    # ---- deploy / rollback -------------------------------------------------
+    def deploy(self, version, warmup=None):
+        """Atomically make `version` the serving model. `warmup(model)` runs
+        BEFORE the swap (old version serves until it completes), so steady
+        state never sees a cold executable. Returns the previous version."""
+        version = str(version)
+        with self._deploy_lock:
+            with self._lock:
+                if version not in self._versions:
+                    raise KeyError(f"unknown version {version!r}")
+                mv = self._versions[version]
+            if warmup is not None:
+                warmup(mv.model)
+            with self._lock:
+                if version not in self._versions:
+                    # concurrently unregistered during warm-up: activating it
+                    # would leave active() raising KeyError forever
+                    raise KeyError(
+                        f"version {version!r} was unregistered during deploy")
+                prev = self._active
+                if prev is not None and prev != version:
+                    self._history.append(prev)
+                self._active = version
+                mv.deployed_at = time.time()
+            return prev
+
+    def rollback(self, warmup=None):
+        """Redeploy the previously active version; returns it. Like deploy,
+        state mutates only after warm-up succeeds: a failed warm-up leaves
+        both the active version and the rollback target intact, so the
+        rollback can simply be retried."""
+        with self._deploy_lock:
+            with self._lock:
+                if not self._history:
+                    raise RuntimeError("no previous version to roll back to")
+                prev = self._history[-1]
+                mv = self._versions[prev]
+            if warmup is not None:
+                warmup(mv.model)
+            with self._lock:
+                if (not self._history or self._history[-1] != prev
+                        or prev not in self._versions):
+                    # target unregistered/changed during warm-up
+                    raise RuntimeError(
+                        f"rollback target {prev!r} changed during warm-up")
+                self._history.pop()
+                self._active = prev
+                mv.deployed_at = time.time()
+            return prev

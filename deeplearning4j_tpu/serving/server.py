@@ -1,0 +1,357 @@
+"""ServingServer: production HTTP front-end over the micro-batcher,
+registry, and admission queue.
+
+Endpoints (all JSON, shared stdlib plumbing from util/http.py):
+  POST /predict   {"data": nested list, "timeout_ms"?: N} or serde envelope
+                  -> {"prediction", "shape", "version"}
+                  429 + Retry-After when shed, 504 when the deadline expires
+  GET  /models    -> {"models": [per-version info], "active": version}
+  POST /deploy    {"version": v, "path"?: zip} -> load (if path) + warm-up +
+                  atomic hot-swap; old version serves during warm-up
+  POST /rollback  -> redeploy the previously active version
+  GET  /metrics   -> latency p50/p95/p99, queue depth, batch-size histogram,
+                  shed/expired counts; also routed to the ui/stats storage
+                  router when one is configured
+  GET  /healthz   -> {"status", "served", "queue_depth", "active_version"}
+"""
+from __future__ import annotations
+
+import json
+import threading
+import time
+from concurrent.futures import Future, TimeoutError as FuturesTimeoutError
+
+import numpy as np
+
+from .admission import (AdmissionQueue, DeadlineExceeded, RejectedError,
+                        Request, safe_set_exception, safe_set_result)
+from .batcher import DynamicBatcher
+from .metrics import ServingMetrics
+from .registry import ModelRegistry, NoModelDeployed
+from ..util.http import BackgroundHttpServer, QuietHandler
+
+
+class ServingServer(BackgroundHttpServer):
+    def __init__(self, model=None, *, registry=None, version="v1",
+                 host="127.0.0.1", port=0, max_batch_size=32,
+                 max_latency_ms=5.0, queue_capacity=256,
+                 default_timeout_ms=None, stats_router=None,
+                 session_id="serving", router_interval_s=10.0,
+                 transform=None):
+        super().__init__(host=host, port=port)
+        self.registry = registry or ModelRegistry()
+        if model is not None:
+            self.registry.register(version, model)
+            self.registry.deploy(version)
+        self.metrics = ServingMetrics(session_id=session_id)
+        self.queue = AdmissionQueue(capacity=queue_capacity,
+                                    metrics=self.metrics)
+        self.batcher = DynamicBatcher(self.registry, self.queue, self.metrics,
+                                      max_batch_size=max_batch_size,
+                                      max_latency_ms=max_latency_ms)
+        self.default_timeout_ms = default_timeout_ms
+        self.stats_router = stats_router
+        self.router_interval_s = float(router_interval_s)
+        self._last_router_flush = None     # None: never flushed
+        self._router_flush_lock = threading.Lock()
+        self._final_flush_done = False
+        self.transform = transform
+
+    # ---- programmatic API --------------------------------------------------
+    def submit(self, x, timeout_ms=None):
+        """Admit one request; returns its Future (shed raises RejectedError)."""
+        x = np.asarray(x)
+        if self.transform is not None:  # applied exactly once, pre-lift
+            x = np.asarray(self.transform(x))
+        return self._submit_transformed(x, timeout_ms)
+
+    def _submit_transformed(self, x, timeout_ms):
+        if x.ndim == 1:
+            # legacy clients may send a single example as a flat vector; it
+            # must not be treated as N one-feature rows (padded/chunked along
+            # the feature axis). Lift to a 1-row batch, squeeze on the way out.
+            inner = self._submit_transformed(x[None], timeout_ms)
+            outer = self._map_future(
+                inner,
+                lambda res: {"prediction": res["prediction"][0],
+                             "version": res["version"]})
+            outer.inner = inner      # lets _abandon cascade to the real work
+            return outer
+        timeout_ms = timeout_ms if timeout_ms is not None \
+            else self.default_timeout_ms
+        deadline = None if timeout_ms is None \
+            else time.monotonic() + float(timeout_ms) / 1000.0
+        if x.shape[0] > self.batcher.max_batch_size:
+            # split server-side instead of dispatching an oversized bucket:
+            # arbitrary row counts would mint unbounded executables past the
+            # log2(max_batch_size)+1 bound and pollute the warm-up set, but
+            # legacy clients may legitimately send any batch size
+            return self._submit_chunked(x, deadline)
+        req = Request(x, deadline=deadline)
+        self.queue.offer(req)
+        return req.future
+
+    def _abandon(self, fut):
+        """Best-effort cancellation of a submitted request whose caller has
+        given up: cancel the future, follow a 1-D lift's `inner` handle, and
+        withdraw any still-queued chunks of an oversized request."""
+        while fut is not None:
+            fut.cancel()
+            for sib in self.queue.withdraw(getattr(fut, "chunks", [])):
+                sib.fail(FuturesTimeoutError("abandoned by handler"))
+            fut = getattr(fut, "inner", None)
+
+    @staticmethod
+    def _map_future(inner, fn):
+        """Future returning fn(inner.result()); errors pass through."""
+        agg = Future()
+
+        def on_done(f):
+            try:
+                res = fn(f.result())
+            except BaseException as e:     # incl. CancelledError
+                safe_set_exception(agg, e)
+                return
+            safe_set_result(agg, res)
+
+        inner.add_done_callback(on_done)
+        return agg
+
+    def _submit_chunked(self, x, deadline):
+        """Enqueue an oversized request as max_batch_size-row chunks and
+        return one future that concatenates the parts in order."""
+        step = self.batcher.max_batch_size
+        reqs = [Request(x[i:i + step], deadline=deadline,
+                        count_as_request=(i == 0))
+                for i in range(0, x.shape[0], step)]
+        agg = Future()
+        remaining = [len(reqs)]
+        lock = threading.Lock()
+
+        def on_done(f):
+            # The success-path concatenate below runs on the batcher thread
+            # (last chunk's complete()) — a bounded single-copy stall, small
+            # next to a dispatch. The failure path (which can run under the
+            # admission lock via expiry) does no concatenation.
+            # Future.exception() raises on a cancelled future, and
+            # CancelledError is a BaseException — handle both explicitly
+            exc = (RuntimeError("chunk cancelled") if f.cancelled()
+                   else f.exception())
+            if exc is not None:
+                # fail fast: pull still-queued siblings back so they don't
+                # burn dispatches whose aggregate the caller won't see
+                for sib in self.queue.withdraw(
+                        [r for r in reqs if not r.future.done()]):
+                    sib.fail(exc)
+            with lock:
+                remaining[0] -= 1
+                if remaining[0]:
+                    return
+            try:
+                parts = [r.future.result() for r in reqs]
+                # chunks dispatch as separate batches, so a hot-swap can
+                # land between them; report honestly instead of claiming
+                # the first chunk's version for all rows
+                versions = sorted({p["version"] for p in parts})
+                res = {"prediction": np.concatenate(
+                           [p["prediction"] for p in parts], axis=0),
+                       "version": (versions[0] if len(versions) == 1
+                                   else versions)}
+            except BaseException as e:     # incl. CancelledError
+                safe_set_exception(agg, e)
+                return
+            safe_set_result(agg, res)
+
+        for r in reqs:
+            r.future.add_done_callback(on_done)
+        self.queue.offer_all(reqs)  # all chunks admitted, or one clean shed
+        agg.chunks = reqs           # lets an abandoning caller withdraw them
+        return agg
+
+    def predict(self, x, timeout_ms=None, wait_s=60.0):
+        """Blocking convenience: submit + wait; returns the result dict with
+        the prediction array and serving version. `wait_s` is per chunk (an
+        oversized request dispatches sequentially, like the HTTP path's
+        scaled wait); a timeout abandons the queued work before re-raising."""
+        return self._await_scaled(self.submit(x, timeout_ms=timeout_ms),
+                                  wait_s)
+
+    def _await_scaled(self, fut, per_chunk_wait_s):
+        """Wait scaled by the (post-transform) chunk count — an oversized
+        request dispatches sequentially, so a flat wait would spuriously
+        abandon progressing work; a real timeout abandons it properly."""
+        n_chunks = len(getattr(fut, "chunks", ())) or 1
+        try:
+            return fut.result(timeout=per_chunk_wait_s * n_chunks)
+        except FuturesTimeoutError:
+            self._abandon(fut)
+            raise
+
+    def deploy(self, version, path=None):
+        """Load (optional) + warm-up + atomic swap; returns prior version.
+        If this call registered the version from `path` and the deploy then
+        fails (e.g. warm-up error), the registration is rolled back so the
+        identical request can simply be retried."""
+        loaded = path is not None
+        if loaded:
+            self.registry.load(version, path)
+        try:
+            return self.registry.deploy(version, warmup=self.batcher.warmup)
+        except Exception:
+            if loaded:
+                self.registry.unregister(version)
+            raise
+
+    def rollback(self):
+        return self.registry.rollback(warmup=self.batcher.warmup)
+
+    # ---- lifecycle ---------------------------------------------------------
+    def start(self):
+        if self._httpd is not None:
+            return self            # already running: idempotent
+        if self.queue.closed:
+            # stop()/start() cycle: a closed queue sheds everything forever
+            # and its batcher thread has exited — rebuild both for resume,
+            # carrying the observed buckets so deploy warm-up still covers
+            # pre-restart traffic shapes
+            self.queue = AdmissionQueue(capacity=self.queue.capacity,
+                                        metrics=self.metrics)
+            observed = set(self.batcher.observed)
+            self.batcher = DynamicBatcher(
+                self.registry, self.queue, self.metrics,
+                max_batch_size=self.batcher.max_batch_size,
+                max_latency_ms=self.batcher.max_latency_ms)
+            self.batcher.observed = observed
+            self._final_flush_done = False
+        self.batcher.start()
+        server = self
+
+        class Handler(QuietHandler):
+            def do_GET(self):
+                if self.path == "/healthz":
+                    self.send_json(200, server._healthz())
+                elif self.path == "/models":
+                    self.send_json(200, {
+                        "models": server.registry.versions(),
+                        "active": server.registry.active_version})
+                elif self.path == "/metrics":
+                    self.send_json(200, server._metrics_snapshot())
+                else:
+                    self.send_json(404, {"error": "not found"})
+
+            def do_POST(self):
+                try:
+                    if self.path == "/predict":
+                        server._handle_predict(self)
+                    elif self.path == "/deploy":
+                        d = json.loads(self.body() or b"{}")
+                        prev = server.deploy(d["version"], path=d.get("path"))
+                        self.send_json(200, {
+                            "active": server.registry.active_version,
+                            "previous": prev})
+                    elif self.path == "/rollback":
+                        active = server.rollback()
+                        self.send_json(200, {"active": active})
+                    else:
+                        self.send_json(404, {"error": "not found"})
+                except RejectedError as e:
+                    self.send_json(429, {"error": str(e)},
+                                   headers={"Retry-After": e.retry_after_s})
+                except Exception as e:
+                    self.send_json(400,
+                                   {"error": f"{type(e).__name__}: {e}"})
+
+        return self.start_with(Handler)
+
+    def stop(self, drain=True, timeout=30.0):
+        """Graceful drain: stop admitting (new requests shed with 429),
+        serve everything already queued, then stop the HTTP server."""
+        self.queue.close()
+        if not drain:
+            self.queue.flush_expired_or_fail()
+        self.batcher.join(timeout)
+        if self.batcher._thread is None:
+            # batcher never ran: nothing will drain the queue — fail what
+            # was admitted instead of leaving callers blocked on futures
+            self.queue.flush_expired_or_fail()
+        if self.stats_router is not None and not self._final_flush_done:
+            # idempotent: double-stop (finally + atexit) must not append
+            # duplicate trailing reports to a durable storage tier — and a
+            # failing/closed router must not abort the shutdown itself
+            self._final_flush_done = True
+            try:
+                self.metrics.flush_to_router(self.stats_router,
+                                             snapshot=self._snapshot())
+            except Exception:
+                pass
+        super().stop()
+
+    # ---- handlers ----------------------------------------------------------
+    def _parse_body(self, body):
+        d = json.loads(body)
+        if "dtype" in d and "shape" in d:     # serde envelope (streaming.serde)
+            from ..streaming.serde import deserialize_array
+            return deserialize_array(d), d
+        return np.asarray(d["data"], dtype=np.float32), d
+
+    def _handle_predict(self, handler):
+        x, d = self._parse_body(handler.body())
+        timeout_ms = d.get("timeout_ms", self.default_timeout_ms)
+        fut = self.submit(x, timeout_ms=timeout_ms)
+        # wait at least the request's own deadline plus dispatch slack — a
+        # client asking for timeout_ms > 60s must not be cut off at 60s
+        per_chunk_wait_s = 60.0 if timeout_ms is None \
+            else float(timeout_ms) / 1000.0 + 60.0
+        try:
+            res = self._await_scaled(fut, per_chunk_wait_s)
+        except DeadlineExceeded as e:
+            handler.send_json(504, {"error": str(e)})
+            return
+        except FuturesTimeoutError:
+            # server-side stall (work already abandoned by _await_scaled),
+            # not a client error: report 503 so load balancers and retry
+            # policies treat it as such
+            handler.send_json(503, {"error": "serving timed out"})
+            return
+        except NoModelDeployed as e:
+            # deploy gap is a server condition too, not the client's fault
+            handler.send_json(503, {"error": str(e)})
+            return
+        out = res["prediction"]
+        handler.send_json(200, {"prediction": out.tolist(),
+                                "shape": list(out.shape),
+                                "version": res["version"]})
+
+    def _healthz(self):
+        return {"status": "ok",
+                "served": self.metrics.rows.get(),
+                "requests": self.metrics.requests.get(),
+                "queue_depth": self.queue.depth(),
+                "active_version": self.registry.active_version}
+
+    def _snapshot(self):
+        return self.metrics.snapshot(
+            queue_depth=self.queue.depth(),
+            version_rows={v["version"]: v["serve_count"]
+                          for v in self.registry.versions()})
+
+    def _metrics_snapshot(self):
+        snap = self._snapshot()
+        # rate-limit the routed copy: a 1 Hz monitoring scraper must not
+        # append one report per GET to a durable storage tier; the
+        # check-and-set is locked so concurrent scrapes flush once
+        if self.stats_router is not None:
+            with self._router_flush_lock:
+                now = time.monotonic()
+                due = (self._last_router_flush is None
+                       or now - self._last_router_flush
+                       >= self.router_interval_s)
+                if due:
+                    self._last_router_flush = now
+            if due:
+                try:
+                    self.metrics.flush_to_router(self.stats_router,
+                                                 snapshot=snap)
+                except Exception:
+                    pass    # a broken router must not fail the scrape itself
+        return snap

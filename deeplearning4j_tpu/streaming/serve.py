@@ -1,62 +1,81 @@
-"""Online inference HTTP server.
+"""Online inference HTTP server — thin compatibility wrapper.
 
 Reference: dl4j-streaming routes/DL4jServeRouteBuilder.java:56-105 (the
 "serve" leg of the route: record in -> model.output -> prediction out).
-Transport is the shared stdlib plumbing (util/http.py); the hot path is the
-model's cached jitted `output`.
 
-Endpoints:
-  POST /predict     body = {"data": nested list} or serde envelope
-                    -> {"prediction": nested list, "shape": [...]}
-  GET  /healthz     -> {"status": "ok", "served": N}
+The implementation moved to the production serving subsystem
+(`deeplearning4j_tpu.serving.ServingServer`): requests now flow through the
+admission queue and dynamic micro-batcher (padded power-of-two buckets, so
+concurrent odd-shaped requests no longer each compile their own XLA
+executable), and the served counter is the race-free metrics counter instead
+of a bare `self.served += n` from concurrent handler threads. The legacy
+surface is preserved: `/predict` (plain and serde-envelope bodies),
+`/healthz` with the served row count, 400-and-keep-serving on bad input —
+plus the new subsystem's `/models`, `/deploy`, `/rollback`, `/metrics`.
 """
 from __future__ import annotations
 
-import json
-
-import numpy as np
-
-from .serde import deserialize_array
-from ..util.http import BackgroundHttpServer, QuietHandler
+from ..serving.server import ServingServer
 
 
-class InferenceServer(BackgroundHttpServer):
+class InferenceServer(ServingServer):
     def __init__(self, model, port=0, host="127.0.0.1", transform=None):
-        super().__init__(host=host, port=port)
-        self.model = model
-        self.transform = transform
-        self.served = 0
+        # max_latency_ms=2: single-request latency stays low while bursts of
+        # concurrent requests still coalesce into one jitted dispatch.
+        super().__init__(model=model, host=host, port=port,
+                         transform=transform, max_latency_ms=2.0,
+                         session_id="inference")
+        self._served_base = 0
 
-    def _predict(self, body: bytes):
-        d = json.loads(body)
-        if "dtype" in d and "shape" in d:  # serde envelope (streaming.serde)
-            x = deserialize_array(d)
-        else:
-            x = np.asarray(d["data"], dtype=np.float32)
-        if self.transform is not None:
-            x = self.transform(x)
-        out = np.asarray(self.model.output(x))
-        self.served += x.shape[0]
-        return {"prediction": out.tolist(), "shape": list(out.shape)}
+    @property
+    def model(self):
+        """The serving model (legacy attribute). Assigning a new model keeps
+        the old idiom working: it registers and hot-swaps a fresh version
+        instead of silently serving the stale one."""
+        return self.registry.active()[1]
 
-    def start(self):
-        server = self
+    @model.setter
+    def model(self, new_model):
+        n = len(self.registry.versions())
+        while True:
+            n += 1
+            name = f"v{n}"
+            try:
+                self.registry.register(name, new_model)
+                break
+            except ValueError:             # name taken: keep counting
+                continue
+        try:
+            prev = self.registry.deploy(name, warmup=self.batcher.warmup)
+        except Exception:
+            # the legacy plain-attribute swap allowed changing the input
+            # contract entirely (e.g. a different feature width), which makes
+            # warm-up on the OLD observed shapes fail — match the old
+            # semantics: forget stale buckets and deploy cold
+            self.batcher.reset_observed()
+            try:
+                prev = self.registry.deploy(name)
+            except Exception:
+                self.registry.unregister(name)  # truly undeployable: no leak
+                raise
+        if prev is not None and prev != name:
+            # legacy single-model semantics: repeated assignment must not
+            # pin every old model in the registry (memory leak). deploy()'s
+            # return value is the true previous version even under
+            # concurrent assignments (it swaps under the deploy lock).
+            self.registry.unregister(prev)
 
-        class Handler(QuietHandler):
-            def do_GET(self):
-                if self.path == "/healthz":
-                    self.send_json(200, {"status": "ok",
-                                         "served": server.served})
-                else:
-                    self.send_json(404, {"error": "not found"})
+    @property
+    def served(self):
+        """Rows served (thread-safe; legacy attribute kept as a property,
+        still assignable — e.g. `server.served = 0` resets the count)."""
+        return self.metrics.rows.get() - self._served_base
 
-            def do_POST(self):
-                if self.path != "/predict":
-                    self.send_json(404, {"error": "not found"})
-                    return
-                try:
-                    self.send_json(200, server._predict(self.body()))
-                except Exception as e:  # surface errors as JSON, keep serving
-                    self.send_json(400, {"error": f"{type(e).__name__}: {e}"})
+    @served.setter
+    def served(self, value):
+        self._served_base = self.metrics.rows.get() - int(value)
 
-        return self.start_with(Handler)
+    def _healthz(self):
+        d = super()._healthz()
+        d["served"] = self.served          # honor a legacy counter reset
+        return d

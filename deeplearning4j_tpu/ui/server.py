@@ -17,6 +17,16 @@ import json
 from ..util.http import BackgroundHttpServer, QuietHandler
 from .storage import InMemoryStatsStorage
 
+# report types that are not per-iteration training stats (activation grids,
+# serving-subsystem metrics) — excluded from score/param time-series views
+_NON_TRAINING_TYPES = ("activations", "serving")
+
+
+def _latest_training(updates):
+    """Newest update that is a real training report, or None."""
+    return next((u for u in reversed(updates)
+                 if u.get("type") not in _NON_TRAINING_TYPES), None)
+
 
 class UIModule:
     """SPI (reference: api/UIModule.java — getRoutes + storage subscription)."""
@@ -68,10 +78,14 @@ class TrainModule(UIModule):
 
     def _overview(self, query, body):
         sid = self._pick_session(query)
-        updates = self.storage.get_all_updates(sid) if sid else []
+        all_updates = self.storage.get_all_updates(sid) if sid else []
+        # a session may carry serving-type reports (serving.ServingMetrics
+        # routes through the same storage tier); the training overview plots
+        # only iteration-scored updates
+        updates = [u for u in all_updates if "score" in u]
         return self._json({
             "session": sid,
-            "iterations": [u["iteration"] for u in updates],
+            "iterations": [u.get("iteration") for u in updates],
             "scores": [u["score"] for u in updates],
             "durations_ms": [u.get("duration_ms") for u in updates],
             "memory": updates[-1].get("memory", {}) if updates else {},
@@ -80,7 +94,21 @@ class TrainModule(UIModule):
     def _model(self, query, body):
         sid = self._pick_session(query)
         static = self.storage.get_static_info(sid) if sid else None
+        # fast path: the indexed latest-update read almost always IS a
+        # training update; when a serving/activations report is newest, scan
+        # a bounded tail rather than the whole session history
         latest = self.storage.get_latest_update(sid) if sid else None
+        if latest is not None and \
+                latest.get("type") in _NON_TRAINING_TYPES:
+            tail_n = 256
+            tail = getattr(self.storage, "get_updates_tail", None)
+            updates = (tail(sid, tail_n) if tail is not None
+                       else self.storage.get_all_updates(sid))
+            latest = _latest_training(updates)
+            if latest is None and tail is not None and len(updates) == tail_n:
+                # >256 consecutive non-training reports: fall back to the
+                # full history rather than blanking real training stats
+                latest = _latest_training(self.storage.get_all_updates(sid))
         return self._json({
             "session": sid,
             "static": static,
@@ -108,7 +136,7 @@ class HistogramModule(UIModule):
         if sid is None and ids:
             sid = ids[-1]
         updates = [u for u in (self.storage.get_all_updates(sid) if sid else [])
-                   if u.get("type") != "activations"]
+                   if u.get("type") not in _NON_TRAINING_TYPES]
         latest = updates[-1] if updates else {}
         series = {}
         for u in updates:
@@ -150,7 +178,7 @@ class FlowModule(UIModule):
             sid = ids[-1]
         static = self.storage.get_static_info(sid) if sid else None
         stats = [u for u in (self.storage.get_all_updates(sid) if sid else [])
-                 if u.get("type") != "activations"]
+                 if u.get("type") not in _NON_TRAINING_TYPES]
         latest = stats[-1] if stats else None
         return 200, "application/json", json.dumps({
             "session": sid,

@@ -96,6 +96,24 @@ class StatsReport:
         return r
 
 
+class ServingStatsReport:
+    """Serving-side report (type "serving"): latency percentiles, queue depth,
+    batch-size histogram, shed/expired counts from serving.ServingMetrics —
+    routed through the same StatsStorageRouter tier as training reports so a
+    UI server tails a live serving process like a training run."""
+
+    def __init__(self, session_id, snapshot):
+        self.data = {
+            "type": "serving",
+            "session_id": session_id,
+            "time": time.time(),
+            **snapshot,
+        }
+
+    def to_json(self):
+        return json.dumps(self.data)
+
+
 def _array_stats(arr, histogram_bins=20):
     a = np.asarray(arr).ravel()
     if a.size == 0:

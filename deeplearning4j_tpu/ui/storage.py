@@ -103,6 +103,15 @@ class InMemoryStatsStorage(StatsStorageRouter, _ListenerHub):
             ups = self._updates.get(session_id)
             return ups[-1] if ups else None
 
+    def get_updates_tail(self, session_id, n):
+        """Last n updates in order (bounded read for latest-of-type scans)."""
+        n = int(n)
+        if n <= 0:                 # ups[-0:] would be the WHOLE history
+            return []
+        with self._lock:
+            ups = self._updates.get(session_id, [])
+            return list(ups[-n:])
+
 
 class FileStatsStorage(InMemoryStatsStorage):
     """Durable JSONL-backed storage (reference: FileStatsStorage.java /
@@ -123,21 +132,40 @@ class FileStatsStorage(InMemoryStatsStorage):
                     else:
                         super().put_update(d)
         self._fh = open(self.path, "a")
+        # the router may be multi-writer (training listener thread + serving
+        # metrics flushes); interleaved writes would corrupt the JSONL log
+        self._fh_lock = threading.Lock()
+        self.dropped_writes = 0    # reports that raced close(): not on disk
+
+    def _append(self, d):
+        with self._fh_lock:
+            if self._fh.closed:
+                # a report racing close() stays visible in memory but is
+                # not durable; surface the divergence instead of raising
+                # out of a metrics scrape or swallowing it silently
+                self.dropped_writes += 1
+                if self.dropped_writes == 1:
+                    import warnings
+                    warnings.warn(
+                        f"FileStatsStorage({self.path}): report arrived "
+                        "after close(); not written to disk")
+                return
+            self._fh.write(json.dumps(d) + "\n")
+            self._fh.flush()
 
     def put_static_info(self, report):
         d = _as_dict(report)
-        self._fh.write(json.dumps(d) + "\n")
-        self._fh.flush()
+        self._append(d)
         super().put_static_info(d)
 
     def put_update(self, report):
         d = _as_dict(report)
-        self._fh.write(json.dumps(d) + "\n")
-        self._fh.flush()
+        self._append(d)
         super().put_update(d)
 
     def close(self):
-        self._fh.close()
+        with self._fh_lock:     # don't close mid-write from another thread
+            self._fh.close()
 
 
 class RemoteUIStatsStorageRouter(StatsStorageRouter):
@@ -261,6 +289,18 @@ class SqliteStatsStorage(StatsStorageRouter, _ListenerHub):
                 "SELECT json FROM updates WHERE session_id=?"
                 " ORDER BY id DESC LIMIT 1", (session_id,)).fetchone()
         return json.loads(row[0]) if row else None
+
+    def get_updates_tail(self, session_id, n):
+        """Last n updates in order via the id index (bounded read)."""
+        n = int(n)
+        if n <= 0:                 # negative LIMIT means unlimited in sqlite
+            return []
+        with contextlib.closing(self._read_conn()) as c:
+            rows = c.execute(
+                "SELECT json FROM updates WHERE session_id=?"
+                " ORDER BY id DESC LIMIT ?",
+                (session_id, n)).fetchall()
+        return [json.loads(r[0]) for r in reversed(rows)]
 
     def get_updates_since(self, session_id, iteration):
         """Indexed range read (J7FileStatsStorage.getAllUpdatesAfter role)."""

@@ -10,47 +10,126 @@ remain useful for host-side input pipelines feeding multiple logical shards.
 """
 from __future__ import annotations
 
+import collections
 import queue
 import threading
+import time
+
+
+class AtomicCounter:
+    """Lock-protected counter shared by serving metrics and the inference
+    servers (the `served` counter was previously mutated bare from concurrent
+    handler threads — a lost-update data race under ThreadingHTTPServer)."""
+
+    def __init__(self, value=0):
+        self._value = int(value)
+        self._lock = threading.Lock()
+
+    def add(self, n=1):
+        with self._lock:
+            self._value += n
+            return self._value
+
+    def get(self):
+        with self._lock:
+            return self._value
+
+    @property
+    def value(self):
+        return self.get()
 
 
 class MagicQueue:
     """Round-robin distribution of items to per-worker bounded queues
-    (reference: parallelism/MagicQueue.java — mode SEQUENTIAL round-robin)."""
+    (reference: parallelism/MagicQueue.java — mode SEQUENTIAL round-robin).
 
-    _SENTINEL = object()
+    `close()` is deterministic: every taker currently blocked in `poll` —
+    however many per worker — wakes and returns None once its queue is empty;
+    items enqueued before the close remain pollable (drain semantics). The
+    previous implementation pushed one sentinel per worker queue, so with two
+    concurrent takers on one worker only one of them ever unblocked."""
 
     def __init__(self, n_workers, capacity=8):
         self.n_workers = int(n_workers)
-        self._queues = [queue.Queue(maxsize=capacity)
-                        for _ in range(self.n_workers)]
+        # capacity<=0 means unbounded, matching the queue.Queue(maxsize=0)
+        # semantics this class previously delegated to
+        self._capacity = int(capacity) if capacity > 0 else float("inf")
+        self._queues = [collections.deque() for _ in range(self.n_workers)]
         self._put_idx = 0
-        self._lock = threading.Lock()
+        self._idx_lock = threading.Lock()   # only the round-robin counter
+        self._closed = False
+        # per-worker locks (like the per-worker stdlib queues this replaces):
+        # traffic on one worker never contends with another's
+        self._locks = [threading.Lock() for _ in range(self.n_workers)]
+        self._not_empty = [threading.Condition(lk) for lk in self._locks]
+        self._not_full = [threading.Condition(lk) for lk in self._locks]
 
     def add(self, item):
-        with self._lock:
+        with self._idx_lock:
             idx = self._put_idx
             self._put_idx = (self._put_idx + 1) % self.n_workers
-        self._queues[idx].put(item)
+        with self._locks[idx]:
+            if self._closed:
+                raise RuntimeError("MagicQueue is closed")
+            while len(self._queues[idx]) >= self._capacity:
+                self._not_full[idx].wait()
+                if self._closed:
+                    raise RuntimeError("MagicQueue is closed")
+            self._queues[idx].append(item)
+            self._not_empty[idx].notify()
 
     put = add
 
     def poll(self, worker, timeout=None):
-        """Take the next item for `worker` (device-affine take)."""
-        try:
-            item = self._queues[worker].get(timeout=timeout)
-        except queue.Empty:
-            return None
-        return None if item is self._SENTINEL else item
+        """Take the next item for `worker` (device-affine take). Returns None
+        on timeout, or — once the queue is closed and drained — immediately."""
+        deadline = None if timeout is None else time.monotonic() + timeout
+        with self._locks[worker]:
+            q = self._queues[worker]
+            while not q:
+                if self._closed:
+                    return None
+                remaining = (None if deadline is None
+                             else deadline - time.monotonic())
+                if remaining is not None and remaining <= 0:
+                    return None
+                self._not_empty[worker].wait(remaining)
+            item = q.popleft()
+            self._not_full[worker].notify()   # one pop frees one slot
+            return item
+
+    def drain(self, worker):
+        """Pop and return everything currently queued for `worker`."""
+        with self._locks[worker]:
+            items = list(self._queues[worker])
+            self._queues[worker].clear()
+            self._not_full[worker].notify_all()
+            return items
+
+    @property
+    def closed(self):
+        return self._closed
 
     def size(self, worker=None):
         if worker is not None:
-            return self._queues[worker].qsize()
-        return sum(q.qsize() for q in self._queues)
+            with self._locks[worker]:
+                return len(self._queues[worker])
+        total = 0
+        for w in range(self.n_workers):
+            with self._locks[w]:
+                total += len(self._queues[w])
+        return total
 
     def close(self):
-        for q in self._queues:
-            q.put(self._SENTINEL)
+        """Stop accepting new items and wake every blocked taker (and any
+        producer blocked on a full queue, which then raises). Setting the
+        flag and notifying under each worker's lock guarantees no waiter
+        misses the wake-up."""
+        for w in range(self.n_workers):
+            with self._locks[w]:
+                self._closed = True
+                self._not_empty[w].notify_all()
+                self._not_full[w].notify_all()
 
 
 class AsyncIterator:

@@ -11,11 +11,14 @@ import threading
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 
 
-def send_json(handler: BaseHTTPRequestHandler, status: int, obj) -> None:
+def send_json(handler: BaseHTTPRequestHandler, status: int, obj,
+              headers=None) -> None:
     payload = json.dumps(obj).encode()
     handler.send_response(status)
     handler.send_header("Content-Type", "application/json")
     handler.send_header("Content-Length", str(len(payload)))
+    for k, v in (headers or {}).items():
+        handler.send_header(k, str(v))
     handler.end_headers()
     handler.wfile.write(payload)
 
@@ -31,8 +34,8 @@ class QuietHandler(BaseHTTPRequestHandler):
     def log_message(self, *a):
         pass
 
-    def send_json(self, status, obj):
-        send_json(self, status, obj)
+    def send_json(self, status, obj, headers=None):
+        send_json(self, status, obj, headers)
 
     def body(self):
         return read_body(self)

@@ -135,6 +135,15 @@ class ModelSerializer:
                 net.opt_state = jax.tree_util.tree_unflatten(treedef, new_leaves)
 
     @staticmethod
+    def read_format(path):
+        """Read the zip's format.json (model class, dtype, version) without
+        deserializing any weights — cheap metadata sniff for model registries."""
+        with zipfile.ZipFile(path, "r") as zf:
+            if FORMAT_ENTRY in zf.namelist():
+                return json.loads(zf.read(FORMAT_ENTRY).decode())
+            return {"model_class": None, "framework": "unknown"}
+
+    @staticmethod
     def restore(path, load_updater=True):
         """Sniff the model type and load it (reference: util/ModelGuesser.java)."""
         with zipfile.ZipFile(path, "r") as zf:

@@ -6,6 +6,14 @@ Mirrors the reference's strategy of testing distributed semantics in-process
 uses master=local[n]); here N virtual XLA CPU devices play that role.
 """
 import os
+import sys
+from pathlib import Path
+
+# repo root on sys.path regardless of how pytest was invoked: tests import
+# repo-level helpers (tools/smoke_serving.py) that are not in the package
+_ROOT = str(Path(__file__).resolve().parents[1])
+if _ROOT not in sys.path:
+    sys.path.insert(0, _ROOT)
 
 _flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in _flags:

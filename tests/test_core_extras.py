@@ -198,6 +198,74 @@ def test_magic_queue_round_robin():
     assert mq.size() == 2
 
 
+def test_magic_queue_close_unblocks_concurrent_takers():
+    """close() must wake EVERY blocked taker deterministically — including
+    several concurrent takers on the same worker (the old sentinel scheme
+    delivered one wake per worker queue, stranding the rest)."""
+    import threading
+    mq = MagicQueue(2)
+    results = []
+    lock = threading.Lock()
+
+    def taker(worker):
+        item = mq.poll(worker, timeout=10)
+        with lock:
+            results.append((worker, item))
+
+    threads = [threading.Thread(target=taker, args=(w,))
+               for w in (0, 0, 1, 1)]          # two takers per worker
+    for t in threads:
+        t.start()
+    import time
+    time.sleep(0.1)                            # let all takers block
+    t0 = time.monotonic()
+    mq.close()
+    for t in threads:
+        t.join(timeout=5)
+    assert time.monotonic() - t0 < 2           # woke, not timed out
+    assert sorted(results) == [(0, None), (0, None), (1, None), (1, None)]
+
+
+def test_magic_queue_drain_after_close():
+    """Items enqueued before close() remain pollable (drain), then poll
+    returns None immediately; add() after close raises."""
+    import pytest as _pytest
+    mq = MagicQueue(2, capacity=4)
+    for i in range(4):
+        mq.add(i)
+    mq.close()
+    assert mq.closed
+    assert mq.poll(0) == 0 and mq.poll(0) == 2   # drain continues
+    assert mq.poll(1) == 1
+    assert mq.drain(1) == [3]                    # bulk drain path
+    assert mq.poll(0) is None and mq.poll(1) is None  # immediate, no block
+    with _pytest.raises(RuntimeError, match="closed"):
+        mq.add(99)
+
+
+def test_magic_queue_close_unblocks_full_producer():
+    """A producer blocked on a full worker queue must not hang across
+    close(): it wakes and raises instead of deadlocking shutdown."""
+    import threading
+    import time
+    mq = MagicQueue(1, capacity=1)
+    mq.add("fills-the-queue")
+    err = []
+
+    def producer():
+        try:
+            mq.add("blocks-until-close")
+        except RuntimeError as e:
+            err.append(e)
+
+    t = threading.Thread(target=producer)
+    t.start()
+    time.sleep(0.1)
+    mq.close()
+    t.join(timeout=5)
+    assert not t.is_alive() and len(err) == 1
+
+
 def test_async_iterator():
     it = AsyncIterator(iter(range(100)), buffer_size=4)
     out = list(it)
