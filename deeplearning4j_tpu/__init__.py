@@ -36,5 +36,7 @@ from .eval.evaluation import Evaluation
 from .eval.roc import ROC, ROCMultiClass, RegressionEvaluation
 from .optimize.listeners import (ScoreIterationListener, PerformanceListener,
                                  CollectScoresIterationListener)
+from .telemetry import (MetricsRegistry, Tracer, TelemetryListener,
+                        enable_tracing, get_registry, get_tracer)
 
 __version__ = "0.1.0"

@@ -28,6 +28,8 @@ from ..layers import feedforward, convolution, recurrent, misc, variational  # n
 from ..multistep import MultiStepTrainable
 from ..updaters import apply_gradient_normalization
 from ...optimize.listeners import resolve_listeners
+from ...telemetry.trace import get_tracer
+from ...telemetry.xla import timed_first_call
 
 
 def _is_weight_key(k):
@@ -267,7 +269,12 @@ class MultiLayerNetwork(MultiStepTrainable):
 
     def _get_train_step(self, key):
         if key not in self._jit_cache:
-            self._jit_cache[key] = self._make_train_step(tbptt="tbptt" in key)
+            # first call compiles the XLA executable; timed_first_call
+            # attributes that cost to jit_compiles_total in the telemetry
+            # registry (the Julia-TPU paper's compile-vs-run accounting)
+            self._jit_cache[key] = timed_first_call(
+                self._make_train_step(tbptt="tbptt" in key),
+                f"train_step:{key}")
         return self._jit_cache[key]
 
     def fit(self, data, labels=None, epochs=1, steps_per_execution=1):
@@ -287,17 +294,19 @@ class MultiLayerNetwork(MultiStepTrainable):
             data = DataSet(data, labels)
         it = as_iterator(data)
         K = max(1, int(steps_per_execution))
+        tracer = get_tracer()          # no-op span per epoch when disabled
         for _ in range(epochs):
-            for listener in self.listeners:
-                listener.on_epoch_start(self)
-            it.reset()
-            if K > 1:
-                self._fit_grouped(it, K)
-            else:
-                for ds in it:
-                    self.fit_batch(ds)
-            for listener in self.listeners:
-                listener.on_epoch_end(self)
+            with tracer.span("epoch", epoch=self.epoch_count):
+                for listener in self.listeners:
+                    listener.on_epoch_start(self)
+                it.reset()
+                if K > 1:
+                    self._fit_grouped(it, K)
+                else:
+                    for ds in it:
+                        self.fit_batch(ds)
+                for listener in self.listeners:
+                    listener.on_epoch_end(self)
             self.epoch_count += 1
         return self
 
@@ -402,8 +411,9 @@ class MultiLayerNetwork(MultiStepTrainable):
                 (params, opt_state, states, _), scores = jax.lax.scan(
                     body, (params, opt_state, states, carries), stacked)
                 return params, opt_state, states, scores
-            self._jit_cache["multi_tbptt"] = jax.jit(
-                multi_tbptt, donate_argnums=(0, 1, 2, 3))
+            self._jit_cache["multi_tbptt"] = timed_first_call(
+                jax.jit(multi_tbptt, donate_argnums=(0, 1, 2, 3)),
+                "train_step:multi_tbptt")
         B = jax.tree_util.tree_leaves(stacked)[0].shape[1]
         carries = self._zero_carries(B, self._dtype)
         (self.params, self.opt_state, self.states,
@@ -416,35 +426,39 @@ class MultiLayerNetwork(MultiStepTrainable):
         """One minibatch step — one XLA computation on device."""
         if self.params is None:
             self.init()
-        x, y, mask, lmask = self._prep_batch(ds)
-        self._rng, step_rng = jax.random.split(self._rng)
+        tracer = get_tracer()          # no-op spans when tracing is off
+        with tracer.span("iteration", iteration=self.iteration_count):
+            x, y, mask, lmask = self._prep_batch(ds)
+            self._rng, step_rng = jax.random.split(self._rng)
 
-        from ..conf.configuration import OptimizationAlgorithm
-        if self.conf.optimization_algo != OptimizationAlgorithm.STOCHASTIC_GRADIENT_DESCENT:
-            # second-order / line-search solvers work on the flattened param
-            # vector (reference: Solver.java:55 factory on OptimizationAlgorithm);
-            # one solver instance per model so its compiled fns are reused
-            if getattr(self, "_flat_solver", None) is None:
-                from ...optimize.solvers import make_solver
-                self._flat_solver = make_solver(
-                    self.conf.optimization_algo, self,
-                    line_search_iterations=self.conf.max_num_line_search_iterations)
-            self._flat_solver.optimize(x, y, mask, lmask)
-        elif (self.conf.backprop_type == BackpropType.TRUNCATED_BPTT and x.ndim == 3
-                and x.shape[1] > self.conf.tbptt_fwd_length):
-            self._fit_tbptt(x, y, mask, lmask, step_rng)
-        else:
-            step = self._get_train_step("std")
-            (self.params, self.opt_state, self.states, score, _,
-             self.last_gradients) = step(
-                self.params, self.opt_state, self.states, step_rng, x, y, mask,
-                lmask, None)
-            self.score_value = score  # device scalar; syncs lazily on read
-        self.iteration_count += 1
-        for listener in self.listeners:
-            if hasattr(listener, "record_batch_size"):
-                listener.record_batch_size(x.shape[0])
-            listener.iteration_done(self, self.iteration_count)
+            from ..conf.configuration import OptimizationAlgorithm
+            if self.conf.optimization_algo != OptimizationAlgorithm.STOCHASTIC_GRADIENT_DESCENT:
+                # second-order / line-search solvers work on the flattened param
+                # vector (reference: Solver.java:55 factory on OptimizationAlgorithm);
+                # one solver instance per model so its compiled fns are reused
+                if getattr(self, "_flat_solver", None) is None:
+                    from ...optimize.solvers import make_solver
+                    self._flat_solver = make_solver(
+                        self.conf.optimization_algo, self,
+                        line_search_iterations=self.conf.max_num_line_search_iterations)
+                with tracer.span("solver_step"):
+                    self._flat_solver.optimize(x, y, mask, lmask)
+            elif (self.conf.backprop_type == BackpropType.TRUNCATED_BPTT and x.ndim == 3
+                    and x.shape[1] > self.conf.tbptt_fwd_length):
+                self._fit_tbptt(x, y, mask, lmask, step_rng)
+            else:
+                step = self._get_train_step("std")
+                with tracer.span("jit_step", rows=int(x.shape[0])):
+                    (self.params, self.opt_state, self.states, score, _,
+                     self.last_gradients) = step(
+                        self.params, self.opt_state, self.states, step_rng,
+                        x, y, mask, lmask, None)
+                self.score_value = score  # device scalar; syncs lazily on read
+            self.iteration_count += 1
+            for listener in self.listeners:
+                if hasattr(listener, "record_batch_size"):
+                    listener.record_batch_size(x.shape[0])
+                listener.iteration_done(self, self.iteration_count)
         if not any(getattr(l, "wants_gradients", False) for l in self.listeners):
             # don't pin a params-sized gradient pytree on device between steps
             self.last_gradients = None
@@ -468,9 +482,11 @@ class MultiLayerNetwork(MultiStepTrainable):
             # gradient truncation at window edges is inherent: each window's
             # value_and_grad differentiates params only; carries enter the next
             # step as concrete (non-differentiated) arguments
-            (self.params, self.opt_state, self.states, score, carries,
-             self.last_gradients) = step(
-                self.params, self.opt_state, self.states, sub, xw, yw, mw, lmw, carries)
+            with get_tracer().span("jit_step", window_start=start):
+                (self.params, self.opt_state, self.states, score, carries,
+                 self.last_gradients) = step(
+                    self.params, self.opt_state, self.states, sub, xw, yw,
+                    mw, lmw, carries)
             scores.append(score)
         # mean stays on device; syncs lazily when score_value is read
         self.score_value = jnp.mean(jnp.stack(scores))
@@ -500,7 +516,8 @@ class MultiLayerNetwork(MultiStepTrainable):
                 out, _, _, _, _ = self._forward(params, states, xx, train=is_train,
                                                 rng=None)
                 return out.astype(self._dtype)
-            self._jit_cache[key] = jax.jit(fwd)
+            self._jit_cache[key] = timed_first_call(
+                jax.jit(fwd), f"output:train={bool(train)}")
         return self._jit_cache[key](self.params, self.states, x)
 
     def feed_forward(self, x, train=False):

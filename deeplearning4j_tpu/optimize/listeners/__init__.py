@@ -7,7 +7,7 @@ ParamAndGradientIterationListener, ComposableIterationListener.
 """
 from __future__ import annotations
 
-import time
+from ...util.time_source import monotonic_s
 
 
 class IterationListener:
@@ -44,7 +44,8 @@ class PerformanceListener(IterationListener):
     optimize/listeners/PerformanceListener.java:99-102 — samples/sec,
     batches/sec, iteration time)."""
 
-    def __init__(self, frequency=1, report_batch=True, report_sample=True, log_fn=print):
+    def __init__(self, frequency=1, report_batch=True, report_sample=True,
+                 log_fn=print, registry=None):
         self.frequency = max(1, int(frequency))
         self.report_batch = report_batch
         self.report_sample = report_sample
@@ -55,12 +56,25 @@ class PerformanceListener(IterationListener):
         self.last_samples_per_sec = float("nan")
         self.last_batches_per_sec = float("nan")
         self.last_iteration_ms = float("nan")
+        # central-registry mirror (telemetry.MetricsRegistry): the same
+        # throughput numbers this listener logs become scrapeable gauges and
+        # a latency histogram instead of private fields only
+        self.registry = registry
+        if registry is not None:
+            self._reg_samples = registry.counter(
+                "training_samples_total", "Example rows trained on")
+            self._reg_iter_ms = registry.histogram(
+                "training_iteration_ms", "Wall ms per training iteration")
+            self._reg_sps = registry.gauge(
+                "training_samples_per_sec", "Recent training throughput")
 
     def record_batch_size(self, n):
         self._samples_since += int(n)
+        if self.registry is not None:
+            self._reg_samples.inc(int(n))
 
     def iteration_done(self, model, iteration):
-        now = time.perf_counter()
+        now = monotonic_s()
         if self._last_time is None:
             self._last_time = now
             self._last_iter = iteration
@@ -73,6 +87,10 @@ class PerformanceListener(IterationListener):
                 self.last_iteration_ms = 1000.0 * dt / iters
                 if self._samples_since:
                     self.last_samples_per_sec = self._samples_since / dt
+                if self.registry is not None:
+                    self._reg_iter_ms.observe(self.last_iteration_ms)
+                    if self._samples_since:
+                        self._reg_sps.set(self.last_samples_per_sec)
                 msg = (f"iteration {iteration}: {self.last_iteration_ms:.2f} ms/iter, "
                        f"{self.last_batches_per_sec:.2f} batches/sec")
                 if self._samples_since:

@@ -14,9 +14,13 @@ Three cooperating pieces in front of the jitted `model.output` hot path:
   latency, and shutdown drains gracefully.
 
 `ServingServer` is the HTTP front-end (/predict, /models, /deploy,
-/rollback, /metrics, /healthz) on the shared util/http plumbing; metrics
-route into the ui/storage stats tier. The legacy
-`streaming.InferenceServer` is now a thin compatibility wrapper over it.
+/rollback, /metrics, /trace, /healthz) on the shared util/http plumbing;
+metrics live in a telemetry.MetricsRegistry (JSON snapshot at /metrics,
+Prometheus text with ?format=prometheus, XLA compile accounting via
+CompileTracker, ui/storage stats-tier routing), and every /predict is
+traced (predict -> admission/batch -> dispatch spans, exported as
+Chrome-trace JSON at /trace). The legacy `streaming.InferenceServer` is now
+a thin compatibility wrapper over it.
 """
 from .admission import (AdmissionQueue, DeadlineExceeded, RejectedError,
                         Request)

@@ -13,9 +13,11 @@ from __future__ import annotations
 
 import collections
 import threading
-import time
 
 from concurrent.futures import Future, InvalidStateError
+
+from ..telemetry.trace import current_span
+from ..util.time_source import monotonic_s
 
 
 def safe_set_result(future, result):
@@ -49,16 +51,20 @@ class DeadlineExceeded(RuntimeError):
 
 class Request:
     __slots__ = ("x", "future", "deadline", "enqueued_at",
-                 "count_as_request")
+                 "count_as_request", "trace_ctx")
 
     def __init__(self, x, deadline=None, count_as_request=True):
         self.x = x
         self.future = Future()
-        self.deadline = deadline          # absolute time.monotonic() or None
-        self.enqueued_at = time.monotonic()
+        self.deadline = deadline          # absolute monotonic_s() or None
+        self.enqueued_at = monotonic_s()
         # chunks of one oversized client request set this on the first chunk
         # only, so metrics.requests counts client calls, not chunks
         self.count_as_request = count_as_request
+        # the handler thread's active span (if any) rides along, so the
+        # batcher thread can parent its admission/batch/dispatch spans under
+        # the originating request — this IS the propagated trace context
+        self.trace_ctx = current_span()
 
     @property
     def rows(self):
@@ -78,7 +84,7 @@ class Request:
 
     def expired(self, now=None):
         return self.deadline is not None and \
-            (now if now is not None else time.monotonic()) > self.deadline
+            (now if now is not None else monotonic_s()) > self.deadline
 
 
 class AdmissionQueue:
@@ -110,7 +116,7 @@ class AdmissionQueue:
     def _purge_dead_locked(self):
         """Drop expired/already-completed entries before a shed decision:
         dead weight must not 429 live traffic off an effectively idle queue."""
-        now = time.monotonic()
+        now = monotonic_s()
         live = collections.deque()
         for req in self._items:
             if req.future.done():
@@ -185,7 +191,7 @@ class AdmissionQueue:
             # the coalescing window never holds a request past its own
             # deadline: the wait is bounded by the earliest deadline in the
             # batch, so timeout_ms < max_latency_ms dispatches on time
-            limit = time.monotonic() + max_wait_s
+            limit = monotonic_s() + max_wait_s
             if first.deadline is not None:
                 limit = min(limit, first.deadline)
             while rows < max_rows:
@@ -198,10 +204,16 @@ class AdmissionQueue:
                         if nxt.deadline is not None:
                             limit = min(limit, nxt.deadline)
                     continue
-                remaining = limit - time.monotonic()
+                remaining = limit - monotonic_s()
                 if remaining <= 0 or self._closed:
                     break
-                self._not_empty.wait(remaining)
+                if not self._not_empty.wait(remaining):
+                    # timed out in REAL time with no new arrivals: dispatch.
+                    # With the default clock this matches the remaining<=0
+                    # check above; with a swapped-in ManualClock (frozen
+                    # monotonic_s) it still bounds the coalescing window, so
+                    # the batcher can never spin on a clock that won't move.
+                    break
             return batch
 
     def _pop_live_locked(self):
@@ -222,7 +234,7 @@ class AdmissionQueue:
         deque scan — producers blocked on this lock in offer() wait for one
         pass per wakeup, not one per coalesced request. Expired requests are
         failed in passing; non-matching ones stay queued."""
-        now = time.monotonic()
+        now = monotonic_s()
         taken = []
         keep = collections.deque()
         budget = max_rows

@@ -19,9 +19,11 @@ output back to per-request futures, and records metrics.
 from __future__ import annotations
 
 import threading
-import time
 
 import numpy as np
+
+from ..telemetry.trace import get_tracer
+from ..util.time_source import monotonic_s
 
 
 def bucket_for(rows):
@@ -34,7 +36,7 @@ def bucket_for(rows):
 
 class DynamicBatcher:
     def __init__(self, registry, queue, metrics, max_batch_size=32,
-                 max_latency_ms=5.0):
+                 max_latency_ms=5.0, tracer=None, compile_tracker=None):
         self.registry = registry
         self.queue = queue
         self.metrics = metrics
@@ -43,6 +45,11 @@ class DynamicBatcher:
         self.observed = set()         # (signature, bucket) pairs dispatched
         self._obs_lock = threading.Lock()
         self._thread = None
+        # telemetry: spans per dispatch (parented under the originating
+        # request's propagated context) + XLA compile accounting — the first
+        # dispatch of an unobserved (signature, bucket) IS the compile
+        self.tracer = tracer if tracer is not None else get_tracer()
+        self.compile_tracker = compile_tracker
 
     # ---- lifecycle --------------------------------------------------------
     def start(self):
@@ -82,32 +89,65 @@ class DynamicBatcher:
         batch = [r for r in batch if not r.future.done()]
         if not batch:
             return
+        taken_at = monotonic_s()
+        tracer = self.tracer
+        # queue-wait spans, recorded retroactively from the timestamps the
+        # queue already stamps — each parented under its own request context
+        for r in batch:
+            tracer.record_span("admission", r.enqueued_at, taken_at,
+                               parent=r.trace_ctx, rows=r.rows)
+        # the batch span parents under the FIRST (oldest) request in the
+        # coalesced batch; its trace therefore shows the full tree while
+        # coalesced followers still get their own admission spans
+        batch_span = tracer.start_span("batch", parent=batch[0].trace_ctx,
+                                       n_requests=len(batch))
         # everything up to the split is inside the try: a failure (no model
         # deployed, bad input, model error) must fail THIS batch's futures,
         # never escape and kill the batcher thread
+        dispatch_span = None
         try:
             version, model = self.registry.active()
             rows = sum(r.rows for r in batch)
             bucket = bucket_for(rows)
+            key = (batch[0].signature, bucket)
+            with self._obs_lock:
+                first_dispatch = key not in self.observed
             x = batch[0].x if len(batch) == 1 else \
                 np.concatenate([r.x for r in batch], axis=0)
             if bucket > rows:
                 pad = np.zeros((bucket - rows,) + x.shape[1:], dtype=x.dtype)
                 x = np.concatenate([x, pad], axis=0)
+            dispatch_span = tracer.start_span(
+                "dispatch", parent=batch_span, bucket=bucket, rows=rows,
+                compiled=first_dispatch)
+            t0 = monotonic_s()
             out = np.asarray(model.output(x))
+            dispatch_ms = (monotonic_s() - t0) * 1000.0
+            dispatch_span.set_attribute("version", version).end()
         except Exception as e:
             self.metrics.errors.add(len(batch))
+            if dispatch_span is not None:
+                # a failed model dispatch is exactly the span an operator
+                # wants to see in /trace — finish it instead of dropping it
+                dispatch_span.set_attribute("error", type(e).__name__).end()
+            batch_span.set_attribute("error", type(e).__name__).end()
             for r in batch:
                 r.fail(e)
             return
         # record AFTER success: a malformed request (e.g. wrong feature
         # count) must not poison every future deploy/rollback warm-up
         with self._obs_lock:
-            self.observed.add((batch[0].signature, bucket))
+            self.observed.add(key)
+        if first_dispatch and self.compile_tracker is not None:
+            # first dispatch of a new bucket = XLA compile + one execution;
+            # attributed as the compile cost (the Julia-TPU paper's proxy)
+            self.compile_tracker.record(dispatch_ms, bucket=bucket,
+                                        phase="serve")
         self.registry.count_served(version, rows)
         self.metrics.record_batch(
             bucket, sum(1 for r in batch if r.count_as_request), rows)
-        now = time.monotonic()
+        now = monotonic_s()
+        batch_span.set_attribute("bucket", bucket).end(now)
         offset = 0
         for r in batch:
             r.complete({"prediction": out[offset:offset + r.rows],
@@ -124,10 +164,18 @@ class DynamicBatcher:
     # ---- warm-up (used by registry deploy/rollback) ------------------------
     def warmup(self, model):
         """Compile `model`'s executables for every (signature, bucket) this
-        batcher has dispatched, so a hot-swapped version is never cold."""
+        batcher has dispatched, so a hot-swapped version is never cold.
+        Warm-up compiles are real XLA compiles and are accounted as such
+        (labeled phase="warmup"), keeping deploy cost visible."""
         with self._obs_lock:
             observed = sorted(self.observed,
                               key=lambda sb: (str(sb[0]), sb[1]))
         for (shape, dtype), bucket in observed:
             zeros = np.zeros((bucket,) + tuple(shape), dtype=dtype)
-            np.asarray(model.output(zeros))   # block until compiled + run
+            with self.tracer.span("warmup_compile", bucket=bucket):
+                t0 = monotonic_s()
+                np.asarray(model.output(zeros))  # block until compiled + run
+                if self.compile_tracker is not None:
+                    self.compile_tracker.record(
+                        (monotonic_s() - t0) * 1000.0, bucket=bucket,
+                        phase="warmup")

@@ -1,55 +1,70 @@
 """Serving metrics: latency percentiles, batch-size histogram, queue depth,
-shed/expiry counts.
+shed/expiry counts — registered in a central telemetry.MetricsRegistry.
 
-All mutation goes through AtomicCounter or the reservoir lock so concurrent
-HTTP handler threads and the batcher thread never race (the seed
-InferenceServer's bare `self.served += n` was a lost-update race). Snapshots
-are plain JSON dicts; `flush_to_router` routes them into the existing
-ui/storage StatsStorageRouter tier so a UI server can tail a live serving
-process exactly like a training run.
+All instruments are the registry's thread-safe counters/histograms, so
+concurrent HTTP handler threads and the batcher thread never race (the seed
+InferenceServer's bare `self.served += n` was a lost-update race) and one
+`/metrics?format=prometheus` scrape exposes everything (request counts,
+latency buckets, compile accounting, queue depth) in exposition format.
+Latency percentiles come from the histogram's bounded reservoir, which is
+copied under its lock and sorted OUTSIDE it — the previous implementation
+sorted the full 4096-sample reservoir while holding the recording lock on
+every snapshot. Snapshots are plain JSON dicts; `flush_to_router` routes
+them into the existing ui/storage StatsStorageRouter tier so a UI server can
+tail a live serving process exactly like a training run.
 """
 from __future__ import annotations
 
-import threading
-import time
-
-from ..util.concurrency import AtomicCounter
+from ..telemetry.registry import MetricsRegistry
 
 
 class ServingMetrics:
     RESERVOIR = 4096  # most-recent latency samples kept for percentiles
 
-    def __init__(self, session_id="serving"):
+    def __init__(self, session_id="serving", registry=None):
         self.session_id = session_id
-        self.requests = AtomicCounter()       # requests answered OK
-        self.rows = AtomicCounter()           # example rows answered OK
-        self.batches = AtomicCounter()        # batches dispatched
-        self.shed = AtomicCounter()           # rejected: queue full (429)
-        self.expired = AtomicCounter()        # rejected: deadline passed
-        self.errors = AtomicCounter()         # failed in model dispatch
-        self._lock = threading.Lock()
-        self._latencies_ms = []               # ring buffer, RESERVOIR cap
-        self._batch_hist = {}                 # padded batch size -> count
+        # default: a registry per serving stack, so two servers in one
+        # process (tests, canaries) never mix counts; pass a shared registry
+        # to aggregate
+        self.registry = registry if registry is not None else MetricsRegistry()
+        reg = self.registry
+        self.requests = reg.counter("requests_total",
+                                    "Client requests answered OK")
+        self.rows = reg.counter("rows_total", "Example rows answered OK")
+        self.batches = reg.counter("batches_total",
+                                   "Coalesced batches dispatched")
+        self.shed = reg.counter("shed_total",
+                                "Requests rejected: queue full (429)")
+        self.expired = reg.counter("expired_total",
+                                   "Requests rejected: deadline passed (504)")
+        self.errors = reg.counter("errors_total",
+                                  "Requests failed in model dispatch")
+        self.batch_size = reg.counter(
+            "batch_size_total", "Dispatched batches by padded bucket size")
+        self.latency = reg.histogram(
+            "latency_ms", "Request latency, admission to completion (ms)")
+        # pre-touch so a scrape before the first request still shows the
+        # series at 0 instead of omitting them
+        for c in (self.requests, self.rows, self.batches, self.shed,
+                  self.expired, self.errors):
+            c.inc(0)
 
     # ---- recording (batcher + handlers) -----------------------------------
     def record_batch(self, bucket_rows, n_requests, n_rows):
         self.batches.add(1)
         self.requests.add(n_requests)
         self.rows.add(n_rows)
-        with self._lock:
-            self._batch_hist[bucket_rows] = \
-                self._batch_hist.get(bucket_rows, 0) + 1
+        self.batch_size.inc(1, bucket=str(bucket_rows))
 
     def record_latency(self, ms):
-        with self._lock:
-            self._latencies_ms.append(float(ms))
-            if len(self._latencies_ms) > self.RESERVOIR:
-                del self._latencies_ms[:len(self._latencies_ms)
-                                       - self.RESERVOIR]
+        self.latency.observe(float(ms))
 
     # ---- reading ----------------------------------------------------------
     @staticmethod
     def _percentile(sorted_vals, q):
+        """Exact percentile over an already-sorted list (kept as a shared
+        utility — tools/smoke_serving.py and tests use it on their own
+        samples; the internal path goes through Histogram.percentiles)."""
         if not sorted_vals:
             return None
         idx = min(len(sorted_vals) - 1, int(round(q * (len(sorted_vals) - 1))))
@@ -58,10 +73,9 @@ class ServingMetrics:
     def snapshot(self, queue_depth=None, version_rows=None):
         """`version_rows` comes from the registry's per-version serve counts
         (the single source of truth) rather than a second counter here."""
-        with self._lock:
-            lat = sorted(self._latencies_ms)
-            batch_hist = dict(self._batch_hist)
-        return {
+        batch_hist = {ls["bucket"]: v for ls, v in self.batch_size.series()
+                      if "bucket" in ls}
+        snap = {
             "requests": self.requests.get(),
             "rows": self.rows.get(),
             "batches": self.batches.get(),
@@ -69,17 +83,22 @@ class ServingMetrics:
             "expired": self.expired.get(),
             "errors": self.errors.get(),
             "queue_depth": queue_depth,
-            "batch_size_histogram": {str(k): v
-                                     for k, v in sorted(batch_hist.items())},
+            "batch_size_histogram": {str(k): v for k, v in
+                                     sorted(batch_hist.items(),
+                                            key=lambda kv: int(kv[0]))},
             "version_rows": version_rows or {},
-            "latency_ms": {
-                "count": len(lat),
-                "p50": self._percentile(lat, 0.50),
-                "p95": self._percentile(lat, 0.95),
-                "p99": self._percentile(lat, 0.99),
-                "max": lat[-1] if lat else None,
-            },
+            "latency_ms": self.latency.percentiles(),
         }
+        compiles = self.registry.get("compiles_total")
+        if compiles is not None:     # CompileTracker shares this registry
+            snap["compiles"] = compiles.get()
+            compile_ms = self.registry.get("compile_ms_total")
+            snap["compile_ms"] = 0 if compile_ms is None else compile_ms.get()
+        return snap
+
+    def to_prometheus(self):
+        """Full exposition text for this serving stack's registry."""
+        return self.registry.to_prometheus()
 
     def flush_to_router(self, router, queue_depth=None, snapshot=None):
         """Post a snapshot (or a caller-provided one) into a ui/storage

@@ -13,10 +13,10 @@ active version the same way.
 from __future__ import annotations
 
 import threading
-import time
 
 from ..util.concurrency import AtomicCounter
 from ..util.model_serializer import ModelSerializer
+from ..util.time_source import now_s
 
 
 class NoModelDeployed(RuntimeError):
@@ -30,7 +30,7 @@ class ModelVersion:
         self.model = model
         self.path = str(path) if path is not None else None
         self.fmt = fmt                       # zip format.json, when file-backed
-        self.loaded_at = time.time()
+        self.loaded_at = now_s()
         self.deployed_at = None
         self.serve_count = AtomicCounter()   # rows served by this version
 
@@ -132,7 +132,7 @@ class ModelRegistry:
                 if prev is not None and prev != version:
                     self._history.append(prev)
                 self._active = version
-                mv.deployed_at = time.time()
+                mv.deployed_at = now_s()
             return prev
 
     def rollback(self, warmup=None):
@@ -156,5 +156,5 @@ class ModelRegistry:
                         f"rollback target {prev!r} changed during warm-up")
                 self._history.pop()
                 self._active = prev
-                mv.deployed_at = time.time()
+                mv.deployed_at = now_s()
             return prev

@@ -1,7 +1,7 @@
 """ServingServer: production HTTP front-end over the micro-batcher,
 registry, and admission queue.
 
-Endpoints (all JSON, shared stdlib plumbing from util/http.py):
+Endpoints (all JSON unless noted, shared stdlib plumbing from util/http.py):
   POST /predict   {"data": nested list, "timeout_ms"?: N} or serde envelope
                   -> {"prediction", "shape", "version"}
                   429 + Retry-After when shed, 504 when the deadline expires
@@ -10,16 +10,21 @@ Endpoints (all JSON, shared stdlib plumbing from util/http.py):
                   atomic hot-swap; old version serves during warm-up
   POST /rollback  -> redeploy the previously active version
   GET  /metrics   -> latency p50/p95/p99, queue depth, batch-size histogram,
-                  shed/expired counts; also routed to the ui/stats storage
+                  shed/expired counts, compile accounting; JSON by default
+                  (back-compat), Prometheus text exposition with
+                  ?format=prometheus; also routed to the ui/stats storage
                   router when one is configured
+  GET  /trace     -> Chrome-trace/Perfetto JSON of recent spans (each
+                  /predict produces a predict -> admission/batch -> dispatch
+                  span tree)
   GET  /healthz   -> {"status", "served", "queue_depth", "active_version"}
 """
 from __future__ import annotations
 
 import json
 import threading
-import time
 from concurrent.futures import Future, TimeoutError as FuturesTimeoutError
+from urllib.parse import parse_qs, urlparse
 
 import numpy as np
 
@@ -28,7 +33,11 @@ from .admission import (AdmissionQueue, DeadlineExceeded, RejectedError,
 from .batcher import DynamicBatcher
 from .metrics import ServingMetrics
 from .registry import ModelRegistry, NoModelDeployed
+from ..telemetry.prometheus import CONTENT_TYPE as PROMETHEUS_CONTENT_TYPE
+from ..telemetry.trace import Tracer
+from ..telemetry.xla import CompileTracker, register_device_memory_gauges
 from ..util.http import BackgroundHttpServer, QuietHandler
+from ..util.time_source import monotonic_s
 
 
 class ServingServer(BackgroundHttpServer):
@@ -37,18 +46,29 @@ class ServingServer(BackgroundHttpServer):
                  max_latency_ms=5.0, queue_capacity=256,
                  default_timeout_ms=None, stats_router=None,
                  session_id="serving", router_interval_s=10.0,
-                 transform=None):
+                 transform=None, tracer=None):
         super().__init__(host=host, port=port)
         self.registry = registry or ModelRegistry()
         if model is not None:
             self.registry.register(version, model)
             self.registry.deploy(version)
         self.metrics = ServingMetrics(session_id=session_id)
+        # telemetry: per-server tracer (bounded buffer, exported at /trace),
+        # XLA compile accounting + device-memory gauges in the same registry
+        # the /metrics exposition renders
+        self.tracer = tracer if tracer is not None else Tracer(enabled=True)
+        self.compile_tracker = CompileTracker(self.metrics.registry)
+        register_device_memory_gauges(self.metrics.registry)
+        self.metrics.registry.gauge(
+            "queue_depth", "Requests admitted and not yet dispatched",
+            fn=lambda: float(self.queue.depth()))
         self.queue = AdmissionQueue(capacity=queue_capacity,
                                     metrics=self.metrics)
         self.batcher = DynamicBatcher(self.registry, self.queue, self.metrics,
                                       max_batch_size=max_batch_size,
-                                      max_latency_ms=max_latency_ms)
+                                      max_latency_ms=max_latency_ms,
+                                      tracer=self.tracer,
+                                      compile_tracker=self.compile_tracker)
         self.default_timeout_ms = default_timeout_ms
         self.stats_router = stats_router
         self.router_interval_s = float(router_interval_s)
@@ -80,7 +100,7 @@ class ServingServer(BackgroundHttpServer):
         timeout_ms = timeout_ms if timeout_ms is not None \
             else self.default_timeout_ms
         deadline = None if timeout_ms is None \
-            else time.monotonic() + float(timeout_ms) / 1000.0
+            else monotonic_s() + float(timeout_ms) / 1000.0
         if x.shape[0] > self.batcher.max_batch_size:
             # split server-side instead of dispatching an oversized bucket:
             # arbitrary row counts would mint unbounded executables past the
@@ -220,7 +240,9 @@ class ServingServer(BackgroundHttpServer):
             self.batcher = DynamicBatcher(
                 self.registry, self.queue, self.metrics,
                 max_batch_size=self.batcher.max_batch_size,
-                max_latency_ms=self.batcher.max_latency_ms)
+                max_latency_ms=self.batcher.max_latency_ms,
+                tracer=self.tracer,
+                compile_tracker=self.compile_tracker)
             self.batcher.observed = observed
             self._final_flush_done = False
         self.batcher.start()
@@ -228,14 +250,22 @@ class ServingServer(BackgroundHttpServer):
 
         class Handler(QuietHandler):
             def do_GET(self):
-                if self.path == "/healthz":
+                u = urlparse(self.path)
+                query = {k: v[0] for k, v in parse_qs(u.query).items()}
+                if u.path == "/healthz":
                     self.send_json(200, server._healthz())
-                elif self.path == "/models":
+                elif u.path == "/models":
                     self.send_json(200, {
                         "models": server.registry.versions(),
                         "active": server.registry.active_version})
-                elif self.path == "/metrics":
-                    self.send_json(200, server._metrics_snapshot())
+                elif u.path == "/metrics":
+                    if query.get("format") == "prometheus":
+                        self.send_text(200, server.metrics.to_prometheus(),
+                                       content_type=PROMETHEUS_CONTENT_TYPE)
+                    else:              # JSON stays the default (back-compat)
+                        self.send_json(200, server._metrics_snapshot())
+                elif u.path == "/trace":
+                    self.send_json(200, server.tracer.to_chrome_trace())
                 else:
                     self.send_json(404, {"error": "not found"})
 
@@ -297,26 +327,37 @@ class ServingServer(BackgroundHttpServer):
     def _handle_predict(self, handler):
         x, d = self._parse_body(handler.body())
         timeout_ms = d.get("timeout_ms", self.default_timeout_ms)
-        fut = self.submit(x, timeout_ms=timeout_ms)
-        # wait at least the request's own deadline plus dispatch slack — a
-        # client asking for timeout_ms > 60s must not be cut off at 60s
-        per_chunk_wait_s = 60.0 if timeout_ms is None \
-            else float(timeout_ms) / 1000.0 + 60.0
-        try:
-            res = self._await_scaled(fut, per_chunk_wait_s)
-        except DeadlineExceeded as e:
-            handler.send_json(504, {"error": str(e)})
-            return
-        except FuturesTimeoutError:
-            # server-side stall (work already abandoned by _await_scaled),
-            # not a client error: report 503 so load balancers and retry
-            # policies treat it as such
-            handler.send_json(503, {"error": "serving timed out"})
-            return
-        except NoModelDeployed as e:
-            # deploy gap is a server condition too, not the client's fault
-            handler.send_json(503, {"error": str(e)})
-            return
+        # root span for the request: submit() runs inside it, so the Request
+        # captures it as trace context and the batcher thread parents its
+        # admission/batch/dispatch spans under this tree
+        with self.tracer.span(
+                "predict",
+                rows=int(x.shape[0]) if x.ndim > 1 else 1) as root:
+            fut = self.submit(x, timeout_ms=timeout_ms)
+            # wait at least the request's own deadline plus dispatch slack —
+            # a client asking for timeout_ms > 60s must not be cut off at 60s
+            per_chunk_wait_s = 60.0 if timeout_ms is None \
+                else float(timeout_ms) / 1000.0 + 60.0
+            try:
+                res = self._await_scaled(fut, per_chunk_wait_s)
+            except DeadlineExceeded as e:
+                root.set_attribute("status", 504)
+                handler.send_json(504, {"error": str(e)})
+                return
+            except FuturesTimeoutError:
+                # server-side stall (work already abandoned by
+                # _await_scaled), not a client error: report 503 so load
+                # balancers and retry policies treat it as such
+                root.set_attribute("status", 503)
+                handler.send_json(503, {"error": "serving timed out"})
+                return
+            except NoModelDeployed as e:
+                # deploy gap is a server condition too, not the client's fault
+                root.set_attribute("status", 503)
+                handler.send_json(503, {"error": str(e)})
+                return
+            root.set_attribute("status", 200)
+            root.set_attribute("version", res["version"])
         out = res["prediction"]
         handler.send_json(200, {"prediction": out.tolist(),
                                 "shape": list(out.shape),
@@ -342,7 +383,7 @@ class ServingServer(BackgroundHttpServer):
         # check-and-set is locked so concurrent scrapes flush once
         if self.stats_router is not None:
             with self._router_flush_lock:
-                now = time.monotonic()
+                now = monotonic_s()
                 due = (self._last_router_flush is None
                        or now - self._last_router_flush
                        >= self.router_interval_s)

@@ -80,7 +80,8 @@ class MessageBroker:
     MAX_POLL_S = 5.0       # server-side blocking cap (see module docstring)
     SEEN_IDS_CAP = 16384   # bounded pub-id dedup window
 
-    def __init__(self, host="127.0.0.1", port=0, topic_capacity=4096):
+    def __init__(self, host="127.0.0.1", port=0, topic_capacity=4096,
+                 registry=None):
         self.host = host
         self._requested_port = int(port)
         self.topic_capacity = int(topic_capacity)
@@ -90,6 +91,31 @@ class MessageBroker:
         self._server = None
         self._thread = None
         self.port = None
+        # streaming registers into the central telemetry registry instead of
+        # keeping private counts: published/polled/dropped-oldest per topic,
+        # plus a queue-depth callback gauge, all visible on a /metrics scrape
+        if registry is None:
+            from ..telemetry.registry import get_registry
+            registry = get_registry()
+        self.registry = registry
+        self._m_published = registry.counter(
+            "streaming_published_total", "Records published, by topic")
+        self._m_polled = registry.counter(
+            "streaming_polled_total", "Records delivered to pollers, by topic")
+        self._m_dropped = registry.counter(
+            "streaming_dropped_total",
+            "Oldest records dropped by back-pressure, by topic")
+        # the depth callback holds only a weakref: a registry (often the
+        # process-global one) must not pin a stopped broker and its queued
+        # records in memory for the process lifetime
+        import weakref
+        ref = weakref.ref(self)
+        self._depth_fn = lambda: (lambda b: b._topic_depths()
+                                  if b is not None else {})(ref())
+        g = registry.gauge("streaming_topic_depth",
+                           "Queued records per topic", fn=self._depth_fn)
+        g.fn_label = "topic"
+        self._depth_gauge = g
 
     def _topic(self, name):
         with self._topics_lock:
@@ -98,6 +124,10 @@ class MessageBroker:
                 q = self._topics[name] = queue.Queue(
                     maxsize=self.topic_capacity)
             return q
+
+    def _topic_depths(self):
+        with self._topics_lock:
+            return {k: v.qsize() for k, v in self._topics.items()}
 
     def _handle(self, req):
         op = req.get("op")
@@ -118,8 +148,10 @@ class MessageBroker:
                 except queue.Full:
                     try:
                         q.get_nowait()  # drop oldest: favor fresh data
+                        self._m_dropped.inc(1, topic=req["topic"])
                     except queue.Empty:
                         pass
+            self._m_published.inc(1, topic=req["topic"])
             return {"ok": True}
         if op == "poll":
             q = self._topic(req["topic"])
@@ -128,6 +160,8 @@ class MessageBroker:
                 msg = q.get(timeout=timeout) if timeout else q.get_nowait()
             except queue.Empty:
                 msg = None
+            if msg is not None:
+                self._m_polled.inc(1, topic=req["topic"])
             return {"msg": msg}
         if op == "stat":
             with self._topics_lock:
@@ -185,6 +219,10 @@ class MessageBroker:
             self._server.shutdown()
             self._server.server_close()
             self._server = None
+        # stop scraping this broker's depths — but only if a later broker
+        # hasn't already taken the shared gauge over
+        if getattr(self._depth_gauge, "_fn", None) is self._depth_fn:
+            self._depth_gauge.set_function(lambda: {})
 
 
 class BrokerClient:

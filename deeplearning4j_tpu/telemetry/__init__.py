@@ -1,0 +1,42 @@
+"""Unified telemetry subsystem (SURVEY.md §5: the reference stack has *no
+tracer* — this is the observability layer the north-star production system
+runs on).
+
+Four cooperating parts, one import surface:
+
+- `trace` — structured tracing: `Tracer` producing nested `Span`s with
+  ids/attributes, a thread-local current-span context propagated through the
+  serving hot path (admission -> micro-batch coalesce -> registry dispatch
+  -> model step) and training (epoch -> iteration -> jit step), exportable
+  as Chrome-trace/Perfetto JSON.
+- `registry` — central `MetricsRegistry`: thread-safe counters, gauges, and
+  bounded histograms with exact-bucket percentiles; ServingMetrics, the
+  training listeners, and streaming all register here instead of keeping
+  private state.
+- `prometheus` — text exposition (`/metrics?format=prometheus` on the
+  ServingServer and the UI server).
+- `xla` — compile/recompile cost accounting (`compiles_total`,
+  `compile_ms_total`, per-bucket compile counts) and device-memory gauges,
+  per the compile-vs-run accounting of the Julia-to-TPU paper (PAPERS.md).
+
+`TelemetryListener` flushes the registry into the existing ui/storage
+router tier so the UI can tail live metrics like training stats.
+"""
+from .listener import TelemetryListener, TelemetryReport
+from .prometheus import CONTENT_TYPE as PROMETHEUS_CONTENT_TYPE
+from .prometheus import render as render_prometheus
+from .registry import (Counter, Gauge, Histogram, MetricsRegistry,
+                       get_registry)
+from .trace import (NOOP_SPAN, Span, Tracer, current_span, enable_tracing,
+                    get_tracer, set_tracer)
+from .xla import (CompileTracker, record_jit_compile,
+                  register_device_memory_gauges, timed_first_call)
+
+__all__ = ["TelemetryListener", "TelemetryReport",
+           "PROMETHEUS_CONTENT_TYPE", "render_prometheus",
+           "Counter", "Gauge", "Histogram", "MetricsRegistry",
+           "get_registry",
+           "NOOP_SPAN", "Span", "Tracer", "current_span", "enable_tracing",
+           "get_tracer", "set_tracer",
+           "CompileTracker", "record_jit_compile",
+           "register_device_memory_gauges", "timed_first_call"]

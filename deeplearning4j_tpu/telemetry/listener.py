@@ -1,0 +1,80 @@
+"""TelemetryListener: the bridge from the central MetricsRegistry into the
+existing ui/storage router tier.
+
+Attached like any training listener, it (a) records per-iteration training
+metrics (iteration time histogram, iteration counter, score gauge) into a
+MetricsRegistry, and (b) every `frequency` iterations flushes the whole
+registry snapshot as a `type: "telemetry"` report through a
+StatsStorageRouter — so a UI server (or a FileStatsStorage/Sqlite tier)
+tails live metrics exactly like training stats, and a Prometheus scraper
+hitting the UI server's `/metrics` sees the same registry.
+"""
+from __future__ import annotations
+
+from .registry import get_registry
+from ..util.time_source import monotonic_s, now_s
+
+
+class TelemetryReport:
+    """`type: "telemetry"` report dict for the stats storage tier."""
+
+    def __init__(self, session_id, snapshot):
+        self.data = {"type": "telemetry", "session_id": session_id,
+                     "time": now_s(), "metrics": snapshot}
+
+    def to_json(self):
+        import json
+        return json.dumps(self.data)
+
+
+class TelemetryListener:
+    """IterationListener recording training metrics into a registry and
+    periodically flushing the registry into a stats storage router."""
+
+    def __init__(self, router=None, registry=None, frequency=10,
+                 session_id="telemetry"):
+        self.router = router
+        self.registry = registry if registry is not None else get_registry()
+        self.frequency = max(1, int(frequency))
+        self.session_id = session_id
+        self._last_mono = None
+        self.iterations = self.registry.counter(
+            "training_iterations_total", "Parameter updates completed")
+        self.epochs = self.registry.counter(
+            "training_epochs_total", "Training epochs completed")
+        self.iteration_ms = self.registry.histogram(
+            "training_iteration_ms", "Wall ms per training iteration")
+        self.score = self.registry.gauge(
+            "training_score", "Latest training loss/score")
+
+    def on_epoch_start(self, model):
+        pass
+
+    def on_epoch_end(self, model):
+        self.epochs.inc(1)
+        self.flush()
+
+    def iteration_done(self, model, iteration):
+        now = monotonic_s()
+        if self._last_mono is not None:
+            self.iteration_ms.observe((now - self._last_mono) * 1000.0)
+        self._last_mono = now
+        self.iterations.inc(1)
+        try:
+            self.score.set(float(model.score_value))
+        except (TypeError, ValueError):
+            pass
+        if iteration % self.frequency == 0:
+            self.flush()
+
+    def flush(self):
+        """Route one registry snapshot into the storage tier (no-op without
+        a router; a broken router must not abort training)."""
+        if self.router is None:
+            return None
+        report = TelemetryReport(self.session_id, self.registry.snapshot())
+        try:
+            self.router.put_update(report)
+        except Exception:
+            return None
+        return report

@@ -1,0 +1,75 @@
+"""Prometheus text exposition (format version 0.0.4) for a MetricsRegistry.
+
+Renders `# HELP` / `# TYPE` headers and one sample line per label-set;
+histograms expand to the standard cumulative `_bucket{le=...}` series plus
+`_sum` and `_count`. This is the scrape side of `/metrics?format=prometheus`
+on both the ServingServer and the UI server (JSON stays the default there
+for back-compat).
+"""
+from __future__ import annotations
+
+CONTENT_TYPE = "text/plain; version=0.0.4; charset=utf-8"
+
+
+def _escape_help(s):
+    return s.replace("\\", "\\\\").replace("\n", "\\n")
+
+
+def _escape_label(s):
+    return str(s).replace("\\", "\\\\").replace('"', '\\"') \
+                 .replace("\n", "\\n")
+
+
+def _fmt_value(v):
+    if v is None:
+        return "NaN"
+    f = float(v)
+    if f == float("inf"):
+        return "+Inf"
+    if f == float("-inf"):
+        return "-Inf"
+    if f == int(f) and abs(f) < 1e15:
+        return str(int(f))
+    return repr(f)
+
+
+def _fmt_labels(labels, extra=None):
+    items = dict(labels)
+    if extra:
+        items.update(extra)
+    if not items:
+        return ""
+    body = ",".join(f'{k}="{_escape_label(v)}"'
+                    for k, v in sorted(items.items()))
+    return "{" + body + "}"
+
+
+def _le(bound):
+    return "+Inf" if bound == float("inf") else _fmt_value(bound)
+
+
+def render(registry) -> str:
+    """The full exposition text for every instrument in `registry`."""
+    lines = []
+    for m in registry.collect():
+        lines.append(f"# HELP {m.name} {_escape_help(m.help)}")
+        lines.append(f"# TYPE {m.name} {m.kind}")
+        if m.kind == "histogram":
+            for labels, data in m.series():
+                for bound, cum in data["buckets"]:
+                    lines.append(
+                        f"{m.name}_bucket"
+                        f"{_fmt_labels(labels, {'le': _le(bound)})}"
+                        f" {_fmt_value(cum)}")
+                lines.append(f"{m.name}_sum{_fmt_labels(labels)}"
+                             f" {_fmt_value(data['sum'])}")
+                lines.append(f"{m.name}_count{_fmt_labels(labels)}"
+                             f" {_fmt_value(data['count'])}")
+        else:
+            series = m.series()
+            if not series:
+                continue
+            for labels, value in series:
+                lines.append(f"{m.name}{_fmt_labels(labels)}"
+                             f" {_fmt_value(value)}")
+    return "\n".join(lines) + "\n"

@@ -22,7 +22,10 @@ import os
 
 import numpy as np
 
+from ..telemetry.registry import get_registry
+from ..telemetry.trace import get_tracer
 from ..util.model_serializer import ModelSerializer
+from ..util.time_source import monotonic_s
 
 
 class CheckpointConfig:
@@ -78,11 +81,26 @@ class FaultTolerantTrainer:
                               ignore_errors=True)
 
     def checkpoint(self):
-        """Write an atomic checkpoint of model + training state."""
+        """Write an atomic checkpoint of model + training state. Cost is
+        accounted in the telemetry registry (checkpoints_total /
+        checkpoint_ms_total) and as a span — checkpoint stalls are a real
+        training-throughput tax worth seeing next to iteration times."""
         it = self.state["iteration"]
         final = os.path.join(self.ckpt.directory, f"ckpt-{it:09d}")
         if os.path.isdir(final):
             return final  # this iteration is already durably checkpointed
+        with get_tracer().span("checkpoint", iteration=it):
+            t0 = monotonic_s()
+            out = self._checkpoint_write(final, it)
+        reg = get_registry()
+        reg.counter("checkpoints_total",
+                    "Durable training checkpoints written").inc(1)
+        reg.counter("checkpoint_ms_total",
+                    "Wall ms spent writing checkpoints").inc(
+                        (monotonic_s() - t0) * 1000.0)
+        return out
+
+    def _checkpoint_write(self, final, it):
         # deterministic tmp name so multi-process jobs (sharded format) agree
         # on the orbax write path; process 0 alone publishes/GCs below
         import jax

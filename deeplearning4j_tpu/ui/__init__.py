@@ -7,7 +7,7 @@ from .storage import (StatsStorageRouter, CollectionStatsStorageRouter,
                       InMemoryStatsStorage, FileStatsStorage,
                       SqliteStatsStorage, RemoteUIStatsStorageRouter)
 from .server import (UIServer, UIModule, TrainModule, DefaultModule,
-                     RemoteReceiverModule)
+                     MetricsModule, RemoteReceiverModule)
 from . import components
 
 __all__ = [
@@ -16,5 +16,5 @@ __all__ = [
     "InMemoryStatsStorage", "FileStatsStorage", "SqliteStatsStorage",
     "RemoteUIStatsStorageRouter",
     "UIServer", "UIModule", "TrainModule", "DefaultModule",
-    "RemoteReceiverModule", "components",
+    "MetricsModule", "RemoteReceiverModule", "components",
 ]

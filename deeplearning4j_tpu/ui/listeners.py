@@ -9,9 +9,9 @@ latest payloads.
 """
 from __future__ import annotations
 
-import time
-
 import numpy as np
+
+from ..util.time_source import now_ms, now_s
 
 
 class ConvolutionalIterationListener:
@@ -24,7 +24,7 @@ class ConvolutionalIterationListener:
         self.router = storage_router
         self.x = np.asarray(reference_input)[:1]  # first example only
         self.frequency = max(1, int(frequency))
-        self.session_id = session_id or f"conv_{int(time.time() * 1000)}"
+        self.session_id = session_id or f"conv_{now_ms()}"
         self.max_channels = int(max_channels)
 
     def on_epoch_start(self, model):
@@ -60,7 +60,7 @@ class ConvolutionalIterationListener:
             "type": "activations",
             "session_id": self.session_id,
             "iteration": iteration,
-            "time": time.time(),
+            "time": now_s(),
             "layers": layers,
         })
 

@@ -18,8 +18,9 @@ from ..util.http import BackgroundHttpServer, QuietHandler
 from .storage import InMemoryStatsStorage
 
 # report types that are not per-iteration training stats (activation grids,
-# serving-subsystem metrics) — excluded from score/param time-series views
-_NON_TRAINING_TYPES = ("activations", "serving")
+# serving-subsystem metrics, telemetry registry flushes) — excluded from
+# score/param time-series views
+_NON_TRAINING_TYPES = ("activations", "serving", "telemetry")
 
 
 def _latest_training(updates):
@@ -240,6 +241,30 @@ class TsneModule(UIModule):
         return 200, "application/json", json.dumps(self._payload).encode()
 
 
+class MetricsModule(UIModule):
+    """Scrape endpoint for the central telemetry registry: `GET /metrics`
+    returns the registry snapshot as JSON (default, back-compat with the
+    serving endpoint's shape) or Prometheus text exposition with
+    `?format=prometheus` — so the training UI process is scrapeable exactly
+    like a ServingServer."""
+
+    def __init__(self, registry=None):
+        if registry is None:
+            from ..telemetry.registry import get_registry
+            registry = get_registry()
+        self.registry = registry
+
+    def routes(self):
+        return {("GET", "/metrics"): self._metrics}
+
+    def _metrics(self, query, body):
+        if query.get("format") == "prometheus":
+            from ..telemetry.prometheus import CONTENT_TYPE
+            return 200, CONTENT_TYPE, self.registry.to_prometheus().encode()
+        return (200, "application/json",
+                json.dumps(self.registry.snapshot()).encode())
+
+
 class RemoteReceiverModule(UIModule):
     """Accepts POSTed reports from RemoteUIStatsStorageRouter (reference:
     module/remote/RemoteReceiverModule.java)."""
@@ -267,12 +292,13 @@ class UIServer(BackgroundHttpServer):
 
     _instance = None
 
-    def __init__(self, port=9000, modules=None):
+    def __init__(self, port=9000, modules=None, registry=None):
         super().__init__(host="127.0.0.1", port=port)
         self.storage = None
         self.modules = modules or [DefaultModule(), TrainModule(),
                                    HistogramModule(), FlowModule(),
                                    ConvolutionalModule(), TsneModule(),
+                                   MetricsModule(registry),
                                    RemoteReceiverModule()]
         self._routes = {}
         for m in self.modules:

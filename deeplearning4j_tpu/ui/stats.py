@@ -14,9 +14,10 @@ from __future__ import annotations
 import gc
 import json
 import os
-import time
 
 import numpy as np
+
+from ..util.time_source import monotonic_s, now_ms, now_s
 
 
 class StatsInitReport:
@@ -28,7 +29,7 @@ class StatsInitReport:
         self.data = {
             "type": "init",
             "session_id": session_id,
-            "time": time.time(),
+            "time": now_s(),
             "backend": jax.default_backend(),
             "devices": [str(d) for d in jax.devices()],
             "n_params": int(model.num_params()) if model.params is not None else 0,
@@ -75,7 +76,7 @@ class StatsReport:
             "type": "stats",
             "session_id": session_id,
             "iteration": iteration,
-            "time": time.time(),
+            "time": now_s(),
             "score": score,
             "param_stats": param_stats or {},
             "gradient_stats": gradient_stats or {},
@@ -106,7 +107,7 @@ class ServingStatsReport:
         self.data = {
             "type": "serving",
             "session_id": session_id,
-            "time": time.time(),
+            "time": now_s(),
             **snapshot,
         }
 
@@ -137,10 +138,10 @@ class StatsListener:
     def __init__(self, storage_router, frequency=1, session_id=None,
                  collect_params=True, collect_gradients=True,
                  collect_activations=False, collect_memory=True,
-                 histogram_bins=20):
+                 histogram_bins=20, registry=None):
         self.router = storage_router
         self.frequency = max(1, int(frequency))
-        self.session_id = session_id or f"session_{int(time.time()*1000)}"
+        self.session_id = session_id or f"session_{now_ms()}"
         self.collect_params = collect_params
         self.collect_gradients = collect_gradients
         self.wants_gradients = collect_gradients  # models keep last_gradients alive
@@ -149,6 +150,16 @@ class StatsListener:
         self.histogram_bins = histogram_bins
         self._initialized = False
         self._last_time = None
+        # central-registry mirror: the iteration timing/score this listener
+        # measures also lands in the shared telemetry.MetricsRegistry, so a
+        # Prometheus scrape of the UI server sees the same numbers as the
+        # stats storage tier (pass registry=... to share a specific one)
+        self.registry = registry
+        if registry is not None:
+            self._reg_iter_ms = registry.histogram(
+                "training_iteration_ms", "Wall ms per training iteration")
+            self._reg_score = registry.gauge(
+                "training_score", "Latest training loss/score")
 
     def on_epoch_start(self, model):
         pass
@@ -162,10 +173,20 @@ class StatsListener:
             self._initialized = True
         if iteration % self.frequency != 0:
             return
-        now = time.perf_counter()
+        now = monotonic_s()
         duration = None if self._last_time is None else \
             (now - self._last_time) * 1000.0
         self._last_time = now
+        if self.registry is not None:
+            if duration is not None:
+                # `duration` spans `frequency` iterations (time between two
+                # OBSERVED iterations); mirror the per-iteration cost so the
+                # shared histogram stays comparable with other recorders
+                self._reg_iter_ms.observe(duration / self.frequency)
+            try:
+                self._reg_score.set(float(model.score_value))
+            except (TypeError, ValueError):
+                pass
 
         param_stats = {}
         if self.collect_params and model.params is not None:
@@ -212,7 +233,14 @@ class StatsListener:
 
 class ProfilerListener:
     """XLA/TPU profiler hook (the TPU analog of the reference's absent tracer —
-    SURVEY.md §5 'no tracer'; jax.profiler traces go to TensorBoard format)."""
+    SURVEY.md §5 'no tracer'; jax.profiler traces go to TensorBoard format).
+
+    The trace window is [start_iteration, start_iteration + n_iterations);
+    if training ends (or the epoch ends) before the window closes, the
+    active trace is stopped rather than leaked — a leaked jax.profiler trace
+    keeps buffering device events for the life of the process and makes the
+    next start_trace raise. `close()` is idempotent and safe to call from a
+    finally block."""
 
     def __init__(self, log_dir, start_iteration=10, n_iterations=5):
         self.log_dir = str(log_dir)
@@ -224,7 +252,9 @@ class ProfilerListener:
         pass
 
     def on_epoch_end(self, model):
-        pass
+        # training may end (or be interrupted) before end_iteration is
+        # reached; an epoch boundary is the last hook we reliably get
+        self.close()
 
     def iteration_done(self, model, iteration):
         import jax
@@ -232,5 +262,20 @@ class ProfilerListener:
             jax.profiler.start_trace(self.log_dir)
             self._active = True
         elif iteration >= self.end_iteration and self._active:
-            jax.profiler.stop_trace()
-            self._active = False
+            self._stop()
+
+    def _stop(self):
+        import jax
+        self._active = False      # never retry a failing stop
+        jax.profiler.stop_trace()
+
+    def close(self):
+        """Stop any still-active trace (idempotent)."""
+        if self._active:
+            self._stop()
+
+    def __del__(self):
+        try:
+            self.close()
+        except Exception:
+            pass

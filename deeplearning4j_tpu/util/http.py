@@ -23,6 +23,19 @@ def send_json(handler: BaseHTTPRequestHandler, status: int, obj,
     handler.wfile.write(payload)
 
 
+def send_text(handler: BaseHTTPRequestHandler, status: int, text,
+              content_type="text/plain; charset=utf-8", headers=None) -> None:
+    """Plain-text response (Prometheus exposition, trace exports)."""
+    payload = text if isinstance(text, bytes) else str(text).encode()
+    handler.send_response(status)
+    handler.send_header("Content-Type", content_type)
+    handler.send_header("Content-Length", str(len(payload)))
+    for k, v in (headers or {}).items():
+        handler.send_header(k, str(v))
+    handler.end_headers()
+    handler.wfile.write(payload)
+
+
 def read_body(handler: BaseHTTPRequestHandler) -> bytes:
     n = int(handler.headers.get("Content-Length", 0))
     return handler.rfile.read(n) if n else b""
@@ -36,6 +49,10 @@ class QuietHandler(BaseHTTPRequestHandler):
 
     def send_json(self, status, obj, headers=None):
         send_json(self, status, obj, headers)
+
+    def send_text(self, status, text, content_type="text/plain; charset=utf-8",
+                  headers=None):
+        send_text(self, status, text, content_type, headers)
 
     def body(self):
         return read_body(self)

@@ -25,6 +25,11 @@ class TimeSource:
     def current_time_millis(self) -> int:
         raise NotImplementedError
 
+    def monotonic(self) -> float:
+        """Monotonic seconds for durations/deadlines (never NTP-corrected:
+        an offset step mid-measurement would corrupt every latency)."""
+        return time.monotonic()
+
 
 class SystemClockTimeSource(TimeSource):
     """(reference: time/SystemClockTimeSource.java)"""
@@ -89,6 +94,25 @@ class NTPTimeSource(TimeSource):
         return int(time.time() * 1000 + self.offset_ms)
 
 
+class ManualClock(TimeSource):
+    """Deterministic test clock: wall and monotonic time advance only via
+    `advance()`, so deadline/latency/telemetry tests stop being wall-clock
+    flaky. Install with TimeSourceProvider.set_instance(ManualClock())."""
+
+    def __init__(self, start_s=1_000_000.0):
+        self._now = float(start_s)
+
+    def advance(self, seconds):
+        self._now += float(seconds)
+        return self._now
+
+    def current_time_millis(self):
+        return int(self._now * 1000)
+
+    def monotonic(self):
+        return self._now
+
+
 class TimeSourceProvider:
     """(reference: time/TimeSourceProvider.java — singleton chosen by system
     property; here the DL4J_TPU_TIMESOURCE env var: 'ntp' or 'system')."""
@@ -104,5 +128,31 @@ class TimeSourceProvider:
         return cls._instance
 
     @classmethod
+    def set_instance(cls, time_source):
+        """Install a specific source (e.g. ManualClock in tests); pass None
+        to fall back to the env-var-selected default on next use."""
+        cls._instance = time_source
+
+    @classmethod
     def reset(cls):
         cls._instance = None
+
+
+# ---- module-level helpers: the single funnel for telemetry timestamps ------
+# Everything observability-facing (stats reports, serving metrics, spans,
+# registry deploy times) calls these instead of bare time.time(), so one
+# set_instance(ManualClock()) makes a whole test run deterministic.
+
+def now_s() -> float:
+    """Wall-clock seconds (epoch) from the configured TimeSource."""
+    return TimeSourceProvider.get_instance().current_time_millis() / 1000.0
+
+
+def now_ms() -> int:
+    """Wall-clock milliseconds (epoch) from the configured TimeSource."""
+    return TimeSourceProvider.get_instance().current_time_millis()
+
+
+def monotonic_s() -> float:
+    """Monotonic seconds from the configured TimeSource (durations only)."""
+    return TimeSourceProvider.get_instance().monotonic()

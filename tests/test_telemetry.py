@@ -1,0 +1,636 @@
+"""Unified telemetry subsystem tests: structured tracing (span nesting,
+cross-thread propagation, Chrome-trace export round-trip), the central
+MetricsRegistry (counters/gauges/histograms, exact-bucket percentiles),
+Prometheus text exposition, XLA compile accounting, the deterministic
+time_source clock, listener coverage (PerformanceListener, ProfilerListener
+with a mocked profiler, TelemetryListener), and the serving/UI scrape +
+trace endpoints (acceptance criteria)."""
+import json
+import threading
+import urllib.request
+
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu.telemetry import (CompileTracker, MetricsRegistry,
+                                          TelemetryListener, Tracer,
+                                          get_registry, render_prometheus)
+from deeplearning4j_tpu.telemetry.trace import NOOP_SPAN, current_span
+from deeplearning4j_tpu.util.time_source import (ManualClock,
+                                                 TimeSourceProvider,
+                                                 monotonic_s, now_ms)
+
+
+@pytest.fixture
+def manual_clock():
+    clock = ManualClock(start_s=1000.0)
+    TimeSourceProvider.set_instance(clock)
+    try:
+        yield clock
+    finally:
+        TimeSourceProvider.reset()
+
+
+# ------------------------------------------------------------------ tracing
+
+def test_span_nesting_parent_ids_and_attributes():
+    t = Tracer()
+    with t.span("root", kind="test") as root:
+        assert current_span() is root
+        with t.span("child") as child:
+            assert child.parent_id == root.span_id
+            assert child.trace_id == root.trace_id
+            with t.span("grandchild") as g:
+                assert g.parent_id == child.span_id
+        assert current_span() is root
+    assert current_span() is None
+    assert root.duration_ms is not None
+    assert root.attributes["kind"] == "test"
+
+
+def test_spans_on_different_threads_do_not_nest_implicitly():
+    t = Tracer()
+    seen = {}
+
+    def worker():
+        seen["span"] = current_span()
+
+    with t.span("root"):
+        th = threading.Thread(target=worker)
+        th.start()
+        th.join()
+    assert seen["span"] is None     # thread-local, not process-global
+
+
+def test_explicit_parent_propagates_across_threads():
+    t = Tracer()
+    with t.span("request") as root:
+        ctx = t.current()
+
+    def consumer():
+        s = t.start_span("dispatch", parent=ctx)
+        s.end()
+        return s
+
+    th_result = []
+    th = threading.Thread(target=lambda: th_result.append(consumer()))
+    th.start()
+    th.join()
+    assert th_result[0].parent_id == root.span_id
+
+
+def test_record_span_retroactive(manual_clock):
+    t = Tracer()
+    t0 = monotonic_s()
+    manual_clock.advance(0.25)
+    s = t.record_span("queued", t0, monotonic_s())
+    assert s.duration_ms == pytest.approx(250.0)
+
+
+def test_chrome_trace_export_round_trip():
+    t = Tracer()
+    with t.span("a"):
+        with t.span("b"):
+            with t.span("c"):
+                pass
+    text = json.dumps(t.to_chrome_trace())
+    trace = json.loads(text)                    # valid JSON
+    ev = trace["traceEvents"]
+    assert len(ev) == 3
+    by_id = {e["args"]["span_id"]: e for e in ev}
+    c = next(e for e in ev if e["name"] == "c")
+    b = by_id[c["args"]["parent_id"]]
+    a = by_id[b["args"]["parent_id"]]
+    assert (a["name"], b["name"]) == ("a", "b")
+    assert a["args"]["parent_id"] is None
+    for e in ev:
+        assert e["ph"] == "X" and e["dur"] >= 0
+
+
+def test_tracer_export_to_file(tmp_path):
+    t = Tracer()
+    with t.span("only"):
+        pass
+    p = t.export(tmp_path / "trace.json")
+    assert json.loads(open(p).read())["traceEvents"][0]["name"] == "only"
+
+
+def test_disabled_tracer_is_noop_and_cheap():
+    t = Tracer(enabled=False)
+    s = t.span("x")
+    assert s is NOOP_SPAN
+    with s:
+        assert current_span() is None
+    assert t.finished_spans() == []
+    assert t.record_span("y", 0, 1) is NOOP_SPAN
+
+
+def test_tracer_ring_buffer_bounded():
+    t = Tracer(max_spans=4)
+    for i in range(10):
+        with t.span(f"s{i}"):
+            pass
+    spans = t.finished_spans()
+    assert len(spans) == 4
+    assert [s.name for s in spans] == ["s6", "s7", "s8", "s9"]
+    assert t.dropped == 6
+
+
+# ----------------------------------------------------------------- registry
+
+def test_counter_labels_and_atomiccounter_compat():
+    reg = MetricsRegistry()
+    c = reg.counter("reqs_total", "help")
+    c.add(3)                      # AtomicCounter spelling
+    c.inc(2, bucket="8")
+    assert c.get() == 5           # unlabeled read sums all series
+    assert c.get(bucket="8") == 2
+    assert c.value == 5
+    with pytest.raises(ValueError):
+        c.inc(-1)
+    # get-or-create is idempotent; a kind clash raises
+    assert reg.counter("reqs_total") is c
+    with pytest.raises(TypeError):
+        reg.gauge("reqs_total")
+
+
+def test_gauge_set_and_callback():
+    reg = MetricsRegistry()
+    g = reg.gauge("depth")
+    g.set(4)
+    assert g.get() == 4
+    cb = reg.gauge("cb_depth", fn=lambda: 7.0)
+    assert cb.get() == 7.0
+    broken = reg.gauge("broken", fn=lambda: 1 / 0)
+    assert broken.get() is None
+    assert broken.series() == []  # dead callback must not kill a scrape
+
+
+def test_histogram_exact_percentiles_and_buckets():
+    reg = MetricsRegistry()
+    h = reg.histogram("lat_ms", buckets=(1, 10, 100))
+    for v in (0.5, 5, 50, 500):
+        h.observe(v)
+    assert h.count() == 4
+    assert h.sum() == pytest.approx(555.5)
+    assert h.percentile(0.0) == 0.5
+    assert h.percentile(1.0) == 500
+    ((labels, data),) = h.series()
+    assert labels == {}
+    assert data["buckets"] == [(1.0, 1), (10.0, 2), (100.0, 3),
+                               (float("inf"), 4)]   # cumulative
+    p = h.percentiles()
+    assert p["count"] == 4 and p["max"] == 500
+
+
+def test_histogram_reservoir_bounded_most_recent():
+    reg = MetricsRegistry()
+    h = reg.histogram("r_ms")
+    h.reservoir_cap = h.RESERVOIR
+    for v in range(h.RESERVOIR + 100):
+        h.observe(float(v))
+    assert h.count() == h.RESERVOIR + 100      # total count is unbounded
+    assert h.percentile(0.0) == 100.0          # oldest 100 evicted
+
+
+def test_registry_snapshot_shape():
+    reg = MetricsRegistry()
+    reg.counter("a_total").inc(1)
+    reg.counter("b_total").inc(2, k="v")
+    reg.gauge("g").set(3)
+    reg.histogram("h").observe(10)
+    snap = reg.snapshot()
+    assert snap["a_total"] == 1
+    assert snap["b_total"] == {"k=v": 2}
+    assert snap["g"] == 3.0
+    assert snap["h"]["count"] == 1 and snap["h"]["p50"] == 10.0
+    json.dumps(snap)               # JSON-serializable end to end
+
+
+# --------------------------------------------------------------- prometheus
+
+def test_prometheus_exposition_format():
+    reg = MetricsRegistry()
+    c = reg.counter("requests_total", 'served "ok"\nrequests')
+    c.inc(5)
+    c.inc(2, route="/predict", code="200")
+    reg.gauge("queue_depth", fn=lambda: 3)
+    h = reg.histogram("latency_ms", buckets=(10, 100))
+    h.observe(7)
+    h.observe(70)
+    text = render_prometheus(reg)
+    lines = text.splitlines()
+    assert "# TYPE requests_total counter" in lines
+    assert '# HELP requests_total served "ok"\\nrequests' in lines
+    assert "requests_total 5" in lines
+    assert 'requests_total{code="200",route="/predict"} 2' in lines
+    assert "# TYPE queue_depth gauge" in lines and "queue_depth 3" in lines
+    assert 'latency_ms_bucket{le="10"} 1' in lines
+    assert 'latency_ms_bucket{le="100"} 2' in lines
+    assert 'latency_ms_bucket{le="+Inf"} 2' in lines
+    assert "latency_ms_sum 77" in lines
+    assert "latency_ms_count 2" in lines
+    assert text.endswith("\n")
+
+
+def test_prometheus_label_escaping():
+    reg = MetricsRegistry()
+    reg.counter("x_total").inc(1, path='a"b\\c')
+    text = render_prometheus(reg)
+    assert 'x_total{path="a\\"b\\\\c"} 1' in text
+
+
+# -------------------------------------------------------------- time source
+
+def test_manual_clock_drives_wall_and_monotonic(manual_clock):
+    t0_wall, t0_mono = now_ms(), monotonic_s()
+    manual_clock.advance(2.5)
+    assert now_ms() - t0_wall == 2500
+    assert monotonic_s() - t0_mono == pytest.approx(2.5)
+
+
+def test_stats_reports_use_time_source(manual_clock):
+    from deeplearning4j_tpu.ui.stats import ServingStatsReport
+    r = ServingStatsReport("s", {"requests": 1})
+    assert r.data["time"] == pytest.approx(1000.0)
+
+
+# ------------------------------------------------------- compile accounting
+
+def test_compile_tracker_counts_and_by_bucket():
+    reg = MetricsRegistry()
+    ct = CompileTracker(reg)
+    ct.record(100.0, bucket=4, phase="serve")
+    ct.record(50.0, bucket=8, phase="serve")
+    ct.record(25.0, bucket=8, phase="warmup")
+    assert ct.total() == 3
+    assert ct.total_ms() == pytest.approx(175.0)
+    text = render_prometheus(reg)
+    assert 'compiles_total{bucket="8",phase="serve"} 1' in text
+    assert "compile_ms_total 175" in text
+
+
+def test_timed_first_call_records_once_and_delegates_attrs():
+    reg = MetricsRegistry()
+    from deeplearning4j_tpu.telemetry.xla import timed_first_call
+    calls = []
+
+    def fn(x):
+        calls.append(x)
+        return x * 2
+    fn.custom_attr = "yes"
+    wrapped = timed_first_call(fn, "unit", registry=reg)
+    assert wrapped(3) == 6 and wrapped(4) == 8
+    assert wrapped.custom_attr == "yes"        # attribute pass-through
+    assert reg.counter("jit_compiles_total").get() == 1
+    assert reg.counter("jit_compiles_total").get(fn="unit") == 1
+
+
+# ---------------------------------------------------------------- listeners
+
+class _Model:
+    score_value = 0.5
+    params = None
+
+
+def test_performance_listener_deterministic_with_manual_clock(manual_clock):
+    from deeplearning4j_tpu.optimize.listeners import PerformanceListener
+    reg = MetricsRegistry()
+    logs = []
+    pl = PerformanceListener(frequency=1, log_fn=logs.append, registry=reg)
+    m = _Model()
+    pl.record_batch_size(32)
+    pl.iteration_done(m, 1)                # primes the clock
+    pl.record_batch_size(32)
+    manual_clock.advance(0.5)
+    pl.iteration_done(m, 2)
+    assert pl.last_iteration_ms == pytest.approx(500.0)
+    assert pl.last_batches_per_sec == pytest.approx(2.0)
+    # the priming iteration does not reset _samples_since, so the first
+    # measured window covers both recorded batches (64 rows / 0.5 s)
+    assert pl.last_samples_per_sec == pytest.approx(128.0)
+    assert logs and "500.00 ms/iter" in logs[0]
+    assert reg.counter("training_samples_total").get() == 64
+    assert reg.histogram("training_iteration_ms").count() == 1
+    assert reg.gauge("training_samples_per_sec").get() == pytest.approx(128.0)
+
+
+class _FakeProfiler:
+    def __init__(self):
+        self.starts = 0
+        self.stops = 0
+
+    def start_trace(self, log_dir):
+        self.starts += 1
+
+    def stop_trace(self):
+        self.stops += 1
+
+
+@pytest.fixture
+def fake_profiler(monkeypatch):
+    import jax
+    fake = _FakeProfiler()
+    monkeypatch.setattr(jax.profiler, "start_trace", fake.start_trace)
+    monkeypatch.setattr(jax.profiler, "stop_trace", fake.stop_trace)
+    return fake
+
+
+def test_profiler_listener_normal_window(fake_profiler, tmp_path):
+    from deeplearning4j_tpu.ui.stats import ProfilerListener
+    pl = ProfilerListener(tmp_path, start_iteration=2, n_iterations=2)
+    m = _Model()
+    for i in range(1, 6):
+        pl.iteration_done(m, i)
+    assert fake_profiler.starts == 1 and fake_profiler.stops == 1
+    pl.close()                               # idempotent: window already shut
+    assert fake_profiler.stops == 1
+
+
+def test_profiler_listener_no_leak_when_training_ends_early(fake_profiler,
+                                                           tmp_path):
+    """Regression: training that ends inside the trace window used to leak
+    an active jax.profiler trace; epoch end (and close()) must stop it."""
+    from deeplearning4j_tpu.ui.stats import ProfilerListener
+    pl = ProfilerListener(tmp_path, start_iteration=1, n_iterations=100)
+    m = _Model()
+    pl.iteration_done(m, 1)                  # trace starts, window never ends
+    assert fake_profiler.starts == 1 and fake_profiler.stops == 0
+    pl.on_epoch_end(m)                       # last reliable hook
+    assert fake_profiler.stops == 1
+    assert not pl._active
+    pl.close()
+    assert fake_profiler.stops == 1          # close() after stop is a no-op
+
+
+def test_telemetry_listener_flushes_registry_into_router():
+    from deeplearning4j_tpu.ui.storage import CollectionStatsStorageRouter
+    reg = MetricsRegistry()
+    router = CollectionStatsStorageRouter()
+    tl = TelemetryListener(router=router, registry=reg, frequency=2,
+                           session_id="tele")
+    m = _Model()
+    for i in range(1, 5):
+        tl.iteration_done(m, i)
+    assert reg.counter("training_iterations_total").get() == 4
+    assert len(router.updates) == 2          # every 2nd iteration
+    d = router.updates[-1].data
+    assert d["type"] == "telemetry" and d["session_id"] == "tele"
+    assert d["metrics"]["training_iterations_total"] == 4
+
+
+def test_telemetry_listener_tolerates_broken_router():
+    class Broken:
+        def put_update(self, r):
+            raise RuntimeError("down")
+    tl = TelemetryListener(router=Broken(), registry=MetricsRegistry(),
+                           frequency=1)
+    tl.iteration_done(_Model(), 1)           # must not raise
+
+
+def test_stats_listener_mirrors_into_registry():
+    from deeplearning4j_tpu.ui.stats import StatsListener
+    from deeplearning4j_tpu.ui.storage import InMemoryStatsStorage
+    reg = MetricsRegistry()
+    sl = StatsListener(InMemoryStatsStorage(), session_id="s",
+                       collect_params=False, collect_gradients=False,
+                       collect_memory=False, registry=reg)
+
+    class M(_Model):
+        def param_table(self):
+            return {}
+
+        def num_params(self):
+            return 0
+    for i in range(1, 4):
+        sl.iteration_done(M(), i)
+    assert reg.histogram("training_iteration_ms").count() == 2
+    assert reg.gauge("training_score").get() == pytest.approx(0.5)
+
+
+# --------------------------------------------------- serving metrics compat
+
+def test_serving_metrics_snapshot_backcompat_and_prometheus():
+    from deeplearning4j_tpu.serving import ServingMetrics
+    sm = ServingMetrics()
+    sm.record_batch(4, n_requests=2, n_rows=3)
+    sm.record_latency(5.0)
+    sm.record_latency(15.0)
+    snap = sm.snapshot(queue_depth=1)
+    assert snap["requests"] == 2 and snap["rows"] == 3
+    assert snap["batches"] == 1
+    assert snap["batch_size_histogram"] == {"4": 1}
+    assert snap["latency_ms"]["count"] == 2
+    assert snap["latency_ms"]["p50"] == 5.0
+    text = sm.to_prometheus()
+    assert "requests_total 2" in text
+    assert 'batch_size_total{bucket="4"} 1' in text
+    assert "latency_ms_count 2" in text
+
+
+# ------------------------------------------------- acceptance: live serving
+
+class StubModel:
+    def output(self, x):
+        return np.asarray(x) * 2.0
+
+
+def test_serving_prometheus_scrape_and_span_tree_acceptance():
+    """Acceptance: GET /metrics?format=prometheus on a live ServingServer
+    returns valid exposition text including requests_total, the latency_ms
+    histogram, compiles_total, and the queue-depth gauge; a traced /predict
+    yields an admission->batch->dispatch span tree under the predict root,
+    exported as valid Chrome-trace JSON with >= 3 nested spans."""
+    from deeplearning4j_tpu.serving import ServingServer
+    server = ServingServer(StubModel(), port=0).start()
+    try:
+        for rows in (1, 3, 2):
+            x = np.ones((rows, 4), dtype=np.float32)
+            req = urllib.request.Request(
+                server.url + "/predict",
+                data=json.dumps({"data": x.tolist()}).encode())
+            with urllib.request.urlopen(req, timeout=30) as r:
+                json.loads(r.read())
+
+        with urllib.request.urlopen(server.url + "/metrics?format=prometheus",
+                                    timeout=30) as r:
+            assert r.headers["Content-Type"].startswith("text/plain")
+            text = r.read().decode()
+        assert "requests_total 3" in text
+        assert "latency_ms_bucket" in text and "latency_ms_count 3" in text
+        assert "compiles_total" in text
+        assert "queue_depth 0" in text
+        # JSON stays the default for back-compat
+        with urllib.request.urlopen(server.url + "/metrics", timeout=30) as r:
+            snap = json.loads(r.read())
+        assert snap["requests"] == 3 and snap["compiles"] >= 2
+
+        with urllib.request.urlopen(server.url + "/trace", timeout=30) as r:
+            trace = json.loads(r.read())        # valid JSON
+        ev = trace["traceEvents"]
+        by_id = {e["args"]["span_id"]: e for e in ev}
+        chains = 0
+        for e in ev:
+            if e["name"] != "dispatch":
+                continue
+            batch = by_id.get(e["args"]["parent_id"])
+            assert batch is not None and batch["name"] == "batch"
+            root = by_id.get(batch["args"]["parent_id"])
+            assert root is not None and root["name"] == "predict"
+            chains += 1
+        assert chains >= 3                      # one tree per request
+        admissions = [e for e in ev if e["name"] == "admission"]
+        assert admissions and all(
+            by_id[a["args"]["parent_id"]]["name"] == "predict"
+            for a in admissions)
+    finally:
+        server.stop()
+
+
+def test_batcher_compile_accounting_once_per_bucket():
+    """The first dispatch of a new (signature, bucket) is the compile; the
+    steady state must add none."""
+    from deeplearning4j_tpu.serving import ServingServer
+    server = ServingServer(StubModel(), max_latency_ms=1.0)
+    server.batcher.start()
+    try:
+        rng = np.random.default_rng(0)
+        for rows in (3, 4):                     # both pad to bucket 4
+            server.predict(rng.normal(size=(rows, 5)).astype(np.float32))
+        assert server.compile_tracker.total() == 1
+        for rows in (3, 4, 3):
+            server.predict(rng.normal(size=(rows, 5)).astype(np.float32))
+        assert server.compile_tracker.total() == 1
+        server.predict(rng.normal(size=(2, 5)).astype(np.float32))
+        assert server.compile_tracker.total() == 2
+        assert server.compile_tracker.by_bucket() != {}
+    finally:
+        server.stop()
+
+
+# --------------------------------------------------------------- UI scrape
+
+def test_ui_server_metrics_endpoint_json_and_prometheus():
+    from deeplearning4j_tpu.ui import UIServer
+    reg = MetricsRegistry()
+    reg.counter("training_iterations_total").inc(7)
+    server = UIServer(port=0, registry=reg).start()
+    try:
+        with urllib.request.urlopen(server.url + "/metrics", timeout=30) as r:
+            snap = json.loads(r.read())
+        assert snap["training_iterations_total"] == 7
+        with urllib.request.urlopen(
+                server.url + "/metrics?format=prometheus", timeout=30) as r:
+            text = r.read().decode()
+        assert "training_iterations_total 7" in text
+    finally:
+        server.stop()
+
+
+def test_ui_overview_ignores_telemetry_reports():
+    """Telemetry registry flushes must not pollute the training overview."""
+    from deeplearning4j_tpu.ui import UIServer, InMemoryStatsStorage
+    storage = InMemoryStatsStorage()
+    storage.put_update({"type": "telemetry", "session_id": "s",
+                        "metrics": {}})
+    storage.put_update({"type": "stats", "session_id": "s", "iteration": 1,
+                        "score": 0.25})
+    server = UIServer(port=0).attach(storage).start()
+    try:
+        with urllib.request.urlopen(server.url + "/train/overview?sid=s",
+                                    timeout=30) as r:
+            ov = json.loads(r.read())
+        assert ov["scores"] == [0.25]
+    finally:
+        server.stop()
+
+
+# -------------------------------------------------------------- smoke tool
+
+def test_smoke_telemetry_tool():
+    """Fast variant of tools/smoke_telemetry.py: serve requests, assert a
+    non-empty prometheus scrape and a valid, nested Chrome-trace export."""
+    import tools.smoke_telemetry as smoke
+    out = smoke.run(n_requests=8, concurrency=4)
+    assert out["requests"] == 8
+    assert out["span_tree_depth"] >= 3
+    assert out["scrape_bytes"] > 0
+
+
+def test_serving_dispatches_under_manual_clock(manual_clock):
+    """Regression: a frozen ManualClock (the deterministic-test setup) must
+    not make the batcher's coalescing window spin forever — the real-time
+    condition wait bounds it."""
+    from deeplearning4j_tpu.serving import ServingServer
+    server = ServingServer(StubModel(), max_latency_ms=5.0)
+    server.batcher.start()
+    try:
+        res = server.predict(np.ones((2, 3), dtype=np.float32), wait_s=30.0)
+        assert res["prediction"].shape == (2, 3)
+    finally:
+        server.stop()
+
+
+def test_enable_tracing_flips_default_tracer_in_place():
+    """Regression: components capture get_tracer() at construction;
+    enable_tracing() must enable that same instance, not swap in a new one."""
+    from deeplearning4j_tpu.telemetry import enable_tracing, get_tracer
+    captured = get_tracer()
+    was_enabled = captured.enabled
+    try:
+        t = enable_tracing()
+        assert t is captured and captured.enabled
+    finally:
+        captured.enabled = was_enabled
+
+
+def test_batcher_failed_dispatch_span_is_exported():
+    """A model error must still finish the dispatch span (tagged error) —
+    the failing dispatch is what an operator looks for in /trace."""
+    from deeplearning4j_tpu.serving import ServingServer
+
+    class Broken:
+        def output(self, x):
+            raise ValueError("bad feature count")
+
+    server = ServingServer(Broken(), max_latency_ms=1.0)
+    server.batcher.start()
+    try:
+        with pytest.raises(ValueError):
+            server.predict(np.ones((1, 3), dtype=np.float32), wait_s=30.0)
+        names = [s.name for s in server.tracer.finished_spans()]
+        assert "dispatch" in names
+        d = next(s for s in server.tracer.finished_spans()
+                 if s.name == "dispatch")
+        assert d.attributes.get("error") == "ValueError"
+        assert d.end_mono is not None
+    finally:
+        server.stop()
+
+
+def test_broker_stop_releases_depth_gauge():
+    from deeplearning4j_tpu.streaming.broker import MessageBroker
+    reg = MetricsRegistry()
+    broker = MessageBroker(port=0, registry=reg).start()
+    broker._topic("t")
+    assert reg.gauge("streaming_topic_depth").get() == {"t": 0}
+    broker.stop()
+    assert reg.gauge("streaming_topic_depth").get() == {}
+
+
+def test_streaming_broker_registers_central_metrics():
+    from deeplearning4j_tpu.streaming.broker import BrokerClient, MessageBroker
+    reg = MetricsRegistry()
+    broker = MessageBroker(port=0, registry=reg).start()
+    try:
+        client = BrokerClient(port=broker.port)
+        client.publish("t1", {"v": 1})
+        client.publish("t1", {"v": 2})
+        assert client.poll("t1")["v"] == 1
+        assert reg.counter("streaming_published_total").get(topic="t1") == 2
+        assert reg.counter("streaming_polled_total").get(topic="t1") == 1
+        depths = reg.gauge("streaming_topic_depth").get()
+        assert depths == {"t1": 1}
+        client.close()
+    finally:
+        broker.stop()
