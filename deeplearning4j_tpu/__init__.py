@@ -32,6 +32,9 @@ from .datasets.iterator.base import (DataSetIterator, ListDataSetIterator,
                                      INDArrayDataSetIterator, AsyncDataSetIterator,
                                      MultipleEpochsIterator, ExistingDataSetIterator,
                                      DevicePrefetchIterator)
+from .etl import (Schema, TransformProcess, DataNormalizer,
+                  NormalizerStandardize, NormalizerMinMaxScaler,
+                  ParallelPipelineExecutor, DevicePrefetcher)
 from .eval.evaluation import Evaluation
 from .eval.roc import ROC, ROCMultiClass, RegressionEvaluation
 from .optimize.listeners import (ScoreIterationListener, PerformanceListener,

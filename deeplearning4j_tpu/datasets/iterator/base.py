@@ -228,11 +228,13 @@ class AsyncDataSetIterator(DataSetIterator):
         self._error = None
         self._stop = None
         self._consumed = False
+        self._error_raised = False
         self._start()
 
     def _start(self):
         self._queue = queue.Queue(maxsize=self.queue_size)
         self._error = None
+        self._error_raised = False
         self._stop = threading.Event()
         stop = self._stop
         q = self._queue
@@ -285,143 +287,71 @@ class AsyncDataSetIterator(DataSetIterator):
         self._fill_peek()
         return v
 
-    def has_next(self):
-        if self._done and self._pending_error is not None:
-            err = self._pending_error
+    def _claim_error(self):
+        """The not-yet-raised worker error, claimed exactly once. Checks
+        `_error` as well as `_pending_error`: a consumer that stops calling
+        next() before the sentinel is drained leaves the error only in
+        `_error`, and reset()/close() must still surface it."""
+        if self._error_raised:
+            return None
+        err = self._pending_error if self._pending_error is not None \
+            else self._error
+        if err is not None:
+            self._error_raised = True
             self._pending_error = None
-            raise err
+        return err
+
+    def has_next(self):
+        if self._done:
+            err = self._claim_error()
+            if err is not None:
+                raise err
         return not self._done
 
-    def reset(self):
-        if not self._consumed and not self._done:
-            return  # fresh iterator: reset is a no-op, keep the prefetched data
+    def _join_worker(self, what):
         if self._thread is not None and self._thread.is_alive():
             self._stop.set()
             self._thread.join(timeout=60)
             if self._thread.is_alive():
                 raise RuntimeError(
                     "AsyncDataSetIterator worker did not stop within 60s; "
-                    "cannot safely reset the underlying iterator")
-        self.underlying.reset()
-        self._start()
+                    f"cannot safely {what} the underlying iterator")
 
-
-class DevicePrefetchIterator(DataSetIterator):
-    """Stages upcoming batches into device HBM from a background thread so the
-    host→device DMA of batch N+1 overlaps the device compute of batch N.
-
-    TPU-native double-buffered infeed: the reference pins its prefetch thread
-    to the consumer's device (AsyncDataSetIterator.java:75-76,
-    Nd4j.getAffinityManager) — here `jax.device_put` is issued ahead of
-    consumption on a worker thread, so by the time `fit_batch` traces the
-    arrays they are already on (or in flight to) the chip. Combine with uint8
-    features + ImageScalerPreProcessor to cut the wire bytes 4×."""
-
-    _SENTINEL = object()
-
-    def __init__(self, underlying, queue_size=2, device=None):
-        self.underlying = underlying
-        self.queue_size = int(queue_size)
-        self.device = device
-        self._start()
-
-    def _put(self, ds):
-        import jax
-        dev = self.device
-        put = lambda a: None if a is None else jax.device_put(a, dev)
-        if hasattr(ds, "features_masks"):  # MultiDataSet
-            from ..dataset import MultiDataSet
-            return MultiDataSet([put(f) for f in ds.features],
-                                [put(l) for l in ds.labels],
-                                None if ds.features_masks is None else
-                                [put(m) for m in ds.features_masks],
-                                None if ds.labels_masks is None else
-                                [put(m) for m in ds.labels_masks])
-        return DataSet(put(ds.features), put(ds.labels),
-                       put(ds.features_mask), put(ds.labels_mask))
-
-    def _start(self):
-        self._queue = queue.Queue(maxsize=self.queue_size)
-        self._error = None
-        self._stop = threading.Event()
-        stop, q = self._stop, self._queue
-
-        def worker():
-            try:
-                while not stop.is_set() and self.underlying.has_next():
-                    item = self._put(self.underlying.next())
-                    while not stop.is_set():
-                        try:
-                            q.put(item, timeout=0.1)
-                            break
-                        except queue.Full:
-                            continue
-            except Exception as e:
-                self._error = e
-            finally:
-                while True:
-                    try:
-                        q.put(self._SENTINEL, timeout=0.1)
-                        break
-                    except queue.Full:
-                        if stop.is_set():
-                            break
-
-        self._thread = threading.Thread(target=worker, daemon=True)
-        self._thread.start()
+    def close(self):
+        """Stop the prefetch worker. A worker error the consumer never saw
+        (it stopped calling next()/has_next()) is re-raised here — exactly
+        once across has_next/reset/close."""
+        self._join_worker("close")
+        self._done = True
         self._peek = None
-        self._done = False
-        self._consumed = False
-        self._pending_error = None
-        self._fill_peek()
-
-    def _fill_peek(self):
-        if self._done:
-            return
-        v = self._queue.get()
-        if v is self._SENTINEL:
-            # mark exhausted: the worker is dead, so a caller that catches a
-            # raised error and polls has_next()/next() again must not block
-            # forever on an empty queue. A worker error is NOT raised here —
-            # the already-prefetched batch in _peek must be delivered first;
-            # has_next() surfaces the error afterwards.
-            self._done = True
-            self._peek = None
-            self._pending_error = self._error
-        else:
-            self._peek = v
-
-    def next(self):
-        v = self._peek
-        self._consumed = True
-        self._fill_peek()
-        return v
-
-    def has_next(self):
-        if self._done and self._pending_error is not None:
-            err = self._pending_error
-            self._pending_error = None
+        err = self._claim_error()
+        if err is not None:
             raise err
-        return not self._done
-
-    def batch(self):
-        return self.underlying.batch()
 
     def reset(self):
         if not self._consumed and not self._done:
-            return  # fresh iterator: keep the prefetched data
-        if self._thread is not None and self._thread.is_alive():
-            self._stop.set()
-            # the worker may legitimately block for a while inside a large
-            # device_put; resetting underneath it would race the shared
-            # iterator cursor, so wait — and fail loudly rather than corrupt
-            self._thread.join(timeout=60)
-            if self._thread.is_alive():
-                raise RuntimeError(
-                    "DevicePrefetchIterator worker did not stop within 60s; "
-                    "cannot safely reset the underlying iterator")
+            return  # fresh iterator: reset is a no-op, keep the prefetched data
+        self._join_worker("reset")
+        err = self._claim_error()
         self.underlying.reset()
         self._start()
+        if err is not None:
+            raise err
+
+
+def DevicePrefetchIterator(underlying, queue_size=2, device=None):
+    """Stages upcoming batches into device HBM from a background thread so
+    the host→device DMA of batch N+1 overlaps the device compute of batch N
+    (TPU-native double-buffered infeed; the reference pins its prefetch
+    thread to the consumer's device, AsyncDataSetIterator.java:75-76).
+    Combine with uint8 features + ImageScalerPreProcessor to cut the wire
+    bytes 4×.
+
+    Historical name kept for the import path; the single implementation is
+    etl.prefetch.DevicePrefetcher (same worker/exactly-once-error contract,
+    plus mesh-sharded placement and telemetry)."""
+    from ...etl.prefetch import DevicePrefetcher   # lazy: etl imports us
+    return DevicePrefetcher(underlying, queue_size=queue_size, device=device)
 
 
 def as_iterator(data, batch_size=None):

@@ -1,0 +1,40 @@
+"""TPU-native ETL subsystem — the DataVec replacement.
+
+The survey's scope fact: DataVec is an *external* dependency of the
+reference repo, so this rebuild ships its own ETL layer. Four cooperating
+pieces, one import surface:
+
+- `schema` / `transform` — declarative column `Schema` over record streams
+  and a chainable, JSON-serializable `TransformProcess` (categorical ->
+  one-hot/integer, min-max & z-score normalize, row filters,
+  derived/renamed/removed columns, sequence windowing), executed
+  *vectorized* on NumPy column batches.
+- `normalizer` — `DataNormalizer` (`NormalizerStandardize` via streaming
+  Welford, `NormalizerMinMaxScaler`): `fit(iterator)` one pass,
+  `transform`/`revert` on DataSets, stats persisted through ModelSerializer
+  (`normalizer.json` in the model zip) so serving applies the identical
+  preprocessing.
+- `pipeline` — `ParallelPipelineExecutor`: N-worker read -> transform ->
+  batch pipeline over MagicQueue with ordered or unordered delivery,
+  backpressure, deterministic close()/drain, and exactly-once error
+  propagation to the consumer.
+- `prefetch` — `DevicePrefetcher`: double/triple-buffered `jax.device_put`
+  ahead of the consuming step, with a sharded mode that splits each batch
+  across the mesh (parallel/sharding) so `network.fit` and ParallelWrapper
+  receive already-resident, already-sharded arrays.
+
+Everything is instrumented through the telemetry layer: per-stage spans,
+`etl_batches_total` / `etl_records_total`, `etl_queue_depth`, and the
+`etl_consumer_wait_ms` histogram (the device-starvation signal).
+"""
+from .normalizer import (DataNormalizer, NormalizerMinMaxScaler,
+                         NormalizerStandardize)
+from .pipeline import ParallelPipelineExecutor
+from .prefetch import DevicePrefetcher
+from .schema import Column, ColumnType, Schema
+from .transform import TransformProcess
+
+__all__ = ["Schema", "Column", "ColumnType", "TransformProcess",
+           "DataNormalizer", "NormalizerStandardize",
+           "NormalizerMinMaxScaler", "ParallelPipelineExecutor",
+           "DevicePrefetcher"]

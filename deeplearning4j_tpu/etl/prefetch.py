@@ -1,0 +1,202 @@
+"""DevicePrefetcher: double/triple-buffered, optionally SHARDED device_put
+ahead of the consuming train step.
+
+Keeping the TPU fed across the host/device boundary is the canonical input
+bottleneck (the Julia-to-TPU paper's compile/transfer accounting, PAPERS.md),
+and what data-parallel training actually consumes is a *per-replica sharded*
+batch (the cross-replica sharding paper, arXiv:2004.13336). This iterator
+stages batch N+1's host->device DMA while the device computes batch N:
+
+- plain mode: `jax.device_put` to one device (the existing
+  datasets.iterator.DevicePrefetchIterator behavior, with telemetry);
+- sharded mode (`mesh=`): each array is placed with the data-axis
+  NamedSharding from parallel/sharding.batch_sharding, so `network.fit` /
+  ShardedTrainer / ParallelWrapper receive already-resident, already-sharded
+  arrays and GSPMD inserts no resharding copy. Batches whose leading dim
+  does not divide the data axis fall back to an unsharded put (the trainer's
+  wrap-padding then handles them).
+
+`queue_size=2` is classic double buffering; 3 adds one more batch of slack
+for jittery producers. Telemetry: `etl_consumer_wait_ms` (shared with the
+pipeline executor — wait ~0 means the device never starves) and the
+`etl_queue_depth` gauge. A producer error is re-raised exactly once, from
+next()/has_next() or — if the consumer already stopped pulling — from
+reset()/close().
+"""
+from __future__ import annotations
+
+import queue
+import threading
+
+from ..datasets.dataset import DataSet, MultiDataSet
+from ..datasets.iterator.base import DataSetIterator
+from ..telemetry.registry import get_registry
+from ..util.time_source import monotonic_s
+
+
+class DevicePrefetcher(DataSetIterator):
+    _SENTINEL = object()
+
+    def __init__(self, underlying, queue_size=2, device=None, mesh=None,
+                 sharding=None, registry=None, name="prefetch"):
+        if sum(x is not None for x in (device, mesh, sharding)) > 1:
+            raise ValueError("pass at most one of device/mesh/sharding")
+        self.underlying = underlying
+        self.queue_size = max(1, int(queue_size))
+        self.device = device
+        self.mesh = mesh
+        self.sharding = sharding
+        self.name = str(name)
+        reg = registry if registry is not None else get_registry()
+        self._m_wait = reg.histogram(
+            "etl_consumer_wait_ms",
+            "Time the consumer blocked waiting for the next ETL batch")
+        self._m_depth = reg.gauge(
+            "etl_queue_depth", "Chunks queued inside ETL pipelines")
+        self._error_raised = False
+        self._start()
+
+    # ---- placement ---------------------------------------------------------
+    def _placement_for(self, a):
+        if self.sharding is not None:
+            return self.sharding
+        if self.mesh is not None:
+            from ..parallel.sharding import DATA_AXIS, batch_sharding
+            n = self.mesh.shape[DATA_AXIS]
+            if a.shape and a.shape[0] % n == 0:
+                return batch_sharding(self.mesh, max(a.ndim, 1))
+            return None             # non-divisible batch: unsharded put
+        return self.device
+
+    def _put(self, ds):
+        import jax
+        import numpy as np
+
+        def put(a):
+            if a is None:
+                return None
+            a = np.asarray(a)
+            return jax.device_put(a, self._placement_for(a))
+        if isinstance(ds, MultiDataSet):
+            return MultiDataSet(
+                [put(f) for f in ds.features], [put(l) for l in ds.labels],
+                None if ds.features_masks is None else
+                [None if m is None else put(m) for m in ds.features_masks],
+                None if ds.labels_masks is None else
+                [None if m is None else put(m) for m in ds.labels_masks])
+        return DataSet(put(ds.features), put(ds.labels),
+                       put(ds.features_mask), put(ds.labels_mask))
+
+    # ---- worker ------------------------------------------------------------
+    def _start(self):
+        self._queue = queue.Queue(maxsize=self.queue_size)
+        self._error = None
+        self._error_raised = False
+        self._stop = threading.Event()
+        stop, q = self._stop, self._queue
+
+        def worker():
+            try:
+                while not stop.is_set() and self.underlying.has_next():
+                    item = self._put(self.underlying.next())
+                    while not stop.is_set():
+                        try:
+                            q.put(item, timeout=0.1)
+                            break
+                        except queue.Full:
+                            continue
+            except Exception as e:
+                self._error = e
+            finally:
+                while True:     # the sentinel must land or the consumer hangs
+                    try:
+                        q.put(self._SENTINEL, timeout=0.1)
+                        break
+                    except queue.Full:
+                        if stop.is_set():
+                            break
+
+        self._thread = threading.Thread(target=worker, daemon=True,
+                                        name=f"{self.name}-device")
+        self._thread.start()
+        self._peek = None
+        self._done = False
+        self._consumed = False
+        self._pending_error = None
+        self._fill_peek()
+
+    def _fill_peek(self):
+        if self._done:
+            return
+        t0 = monotonic_s()
+        v = self._queue.get()
+        self._m_wait.observe((monotonic_s() - t0) * 1000.0,
+                             pipeline=self.name)
+        self._m_depth.set(self._queue.qsize(), pipeline=self.name)
+        if v is self._SENTINEL:
+            # exhausted; an error is held until the already-prefetched batch
+            # is delivered, then surfaced exactly once (has_next or
+            # reset/close, whichever the consumer reaches first)
+            self._done = True
+            self._peek = None
+            self._pending_error = self._error
+        else:
+            self._peek = v
+
+    def _claim_error(self):
+        """The not-yet-raised producer error, claimed exactly once."""
+        if self._error_raised:
+            return None
+        err = self._pending_error if self._pending_error is not None \
+            else self._error
+        if err is not None:
+            self._error_raised = True
+            self._pending_error = None
+        return err
+
+    # ---- DataSetIterator contract ------------------------------------------
+    def next(self):
+        v = self._peek
+        self._consumed = True
+        self._fill_peek()
+        return v
+
+    def has_next(self):
+        if self._done:
+            err = self._claim_error()
+            if err is not None:
+                raise err
+        return not self._done
+
+    def batch(self):
+        return self.underlying.batch()
+
+    def _join_worker(self, what):
+        if self._thread is not None and self._thread.is_alive():
+            self._stop.set()
+            # the worker may legitimately block inside a large device_put;
+            # interrupting mid-transfer would race the shared iterator
+            self._thread.join(timeout=60)
+            if self._thread.is_alive():
+                raise RuntimeError(
+                    f"DevicePrefetcher worker did not stop within 60s; "
+                    f"cannot safely {what}")
+
+    def close(self):
+        """Stop the worker; surface a swallowed producer error exactly once."""
+        self._join_worker("close")
+        self._done = True
+        self._peek = None
+        err = self._claim_error()
+        if err is not None:
+            raise err
+
+    def reset(self):
+        if not self._consumed and not self._done:
+            return                  # fresh iterator: keep the prefetched data
+        self._join_worker("reset")
+        err = self._claim_error()
+        self.underlying.reset()
+        self._start()
+        if err is not None:
+            raise err

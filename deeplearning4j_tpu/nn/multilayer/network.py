@@ -277,9 +277,11 @@ class MultiLayerNetwork(MultiStepTrainable):
                 f"train_step:{key}")
         return self._jit_cache[key]
 
-    def fit(self, data, labels=None, epochs=1, steps_per_execution=1):
-        """Train. `data` may be a DataSetIterator-like, a DataSet, or (x, y)
-        arrays (reference: fit(DataSetIterator) :902 and fit(INDArray,INDArray)).
+    def fit(self, data, labels=None, epochs=1, steps_per_execution=1,
+            prefetch=None):
+        """Train. `data` may be a DataSetIterator-like (including an
+        etl.ParallelPipelineExecutor), a DataSet, or (x, y) arrays
+        (reference: fit(DataSetIterator) :902 and fit(INDArray,INDArray)).
 
         steps_per_execution=K compiles K optimizer steps into ONE executable
         (lax.scan with donated carry — see nn/multistep.py): one host
@@ -287,27 +289,46 @@ class MultiLayerNetwork(MultiStepTrainable):
         loop (StochasticGradientDescent.java:51-72). Listeners then fire on
         a K-step cadence; ragged tails and incompatible groups (TBPTT
         windowing, non-SGD solvers, mismatched shapes) fall back to
-        per-batch steps."""
+        per-batch steps.
+
+        prefetch=K wraps the iterator in an etl.DevicePrefetcher with a
+        K-deep buffer (2 = double, 3 = triple buffering): batch N+1's
+        host->device transfer overlaps batch N's compute, so the jit step
+        traces arrays that are already device-resident."""
         from ...datasets.dataset import DataSet
         from ...datasets.iterator.base import as_iterator
         if labels is not None:
             data = DataSet(data, labels)
         it = as_iterator(data)
+        wrapped = None
+        if prefetch:
+            from ...etl.prefetch import DevicePrefetcher
+            it = wrapped = DevicePrefetcher(it, queue_size=int(prefetch))
         K = max(1, int(steps_per_execution))
         tracer = get_tracer()          # no-op span per epoch when disabled
-        for _ in range(epochs):
-            with tracer.span("epoch", epoch=self.epoch_count):
-                for listener in self.listeners:
-                    listener.on_epoch_start(self)
-                it.reset()
-                if K > 1:
-                    self._fit_grouped(it, K)
-                else:
-                    for ds in it:
-                        self.fit_batch(ds)
-                for listener in self.listeners:
-                    listener.on_epoch_end(self)
-            self.epoch_count += 1
+        try:
+            for _ in range(epochs):
+                with tracer.span("epoch", epoch=self.epoch_count):
+                    for listener in self.listeners:
+                        listener.on_epoch_start(self)
+                    it.reset()
+                    if K > 1:
+                        self._fit_grouped(it, K)
+                    else:
+                        for ds in it:
+                            self.fit_batch(ds)
+                    for listener in self.listeners:
+                        listener.on_epoch_end(self)
+                self.epoch_count += 1
+        except BaseException:
+            if wrapped is not None:
+                try:
+                    wrapped.close()
+                except Exception:
+                    pass           # don't mask the primary training error
+            raise
+        if wrapped is not None:
+            wrapped.close()        # stop the fit-owned prefetch thread
         return self
 
     def _prep_batch(self, ds):

@@ -13,15 +13,19 @@ allreduce-every-step semantics is the averagingFrequency=1 limit, applied to
 gradients rather than parameters — equivalent for SGD, and the mode the
 reference recommends for correctness).
 
-The `prefetch_buffer` option wraps the iterator in AsyncDataSetIterator exactly
-like the reference does.
+The `prefetch_buffer` option stages batches AHEAD of the step like the
+reference's AsyncDataSetIterator — but device-side and sharded: each batch is
+split across the mesh's data axis by etl.DevicePrefetcher while the previous
+step computes, so the sharded train step consumes already-resident,
+already-sharded arrays (per-replica placement is what data-parallel training
+actually consumes — the cross-replica sharding paper, PAPERS.md).
 """
 from __future__ import annotations
 
 import jax
 
 from .sharding import ShardedTrainer, ShardingRules, make_mesh
-from ..datasets.iterator.base import AsyncDataSetIterator, as_iterator
+from ..datasets.iterator.base import as_iterator
 
 
 class ParallelWrapper:
@@ -78,14 +82,30 @@ class ParallelWrapper:
     def fit(self, iterator, epochs=1):
         """(reference: ParallelWrapper.fit :322) Each step shards the global
         batch over the data axis; partial batches are wrap-padded with
-        loss-masked rows, so no example is dropped."""
+        loss-masked rows, so no example is dropped. With prefetch_buffer > 0
+        the next batch is device_put sharded over the mesh while the current
+        step runs (etl.DevicePrefetcher)."""
         it = as_iterator(iterator)
+        wrapped = None
         if self.prefetch_buffer and it.async_supported():
-            it = AsyncDataSetIterator(it, queue_size=self.prefetch_buffer)
-        for _ in range(epochs):
-            it.reset()
-            for ds in it:
-                self.trainer.fit_batch(ds)
+            from ..etl.prefetch import DevicePrefetcher
+            it = wrapped = DevicePrefetcher(
+                it, queue_size=self.prefetch_buffer,
+                mesh=self.trainer.mesh, name="parallel_wrapper")
+        try:
+            for _ in range(epochs):
+                it.reset()
+                for ds in it:
+                    self.trainer.fit_batch(ds)
+        except BaseException:
+            if wrapped is not None:
+                try:
+                    wrapped.close()
+                except Exception:
+                    pass           # don't mask the primary training error
+            raise
+        if wrapped is not None:
+            wrapped.close()
         return self.model
 
     def shutdown(self):

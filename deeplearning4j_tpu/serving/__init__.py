@@ -9,6 +9,10 @@ Three cooperating pieces in front of the jitted `model.output` hot path:
   hot-swap: `deploy` warm-compiles the incoming version on every observed
   bucket while the old version keeps serving, then swaps the pointer;
   `rollback` redeploys the previous version. Per-version serve counts.
+  `scan_dir=` makes it persistent: zips in the directory load at startup
+  and `/deploy` accepts any model name from it. A zip's `normalizer.json`
+  (etl.DataNormalizer stats saved at training time) becomes the version's
+  feature transform, applied to every batch it serves.
 - `AdmissionQueue` — bounded queue with per-request deadlines; a full queue
   sheds immediately (HTTP 429 + Retry-After) instead of queueing unbounded
   latency, and shutdown drains gracefully.

@@ -106,23 +106,42 @@ class DynamicBatcher:
         # never escape and kill the batcher thread
         dispatch_span = None
         try:
-            version, model = self.registry.active()
+            # ONE registry snapshot per batch: model + the version-owned
+            # preprocessing (a zip's normalizer) can never mix across a swap
+            entry = self.registry.active_entry()
+            version, model = entry.version, entry.model
             rows = sum(r.rows for r in batch)
             bucket = bucket_for(rows)
-            key = (batch[0].signature, bucket)
-            with self._obs_lock:
-                first_dispatch = key not in self.observed
             x = batch[0].x if len(batch) == 1 else \
                 np.concatenate([r.x for r in batch], axis=0)
             if bucket > rows:
                 pad = np.zeros((bucket - rows,) + x.shape[1:], dtype=x.dtype)
                 x = np.concatenate([x, pad], axis=0)
+            if entry.transform is not None:
+                # shape-preserving (normalizers are per-element affine); the
+                # normalizer's own float32 output dtype flows through —
+                # casting back to the request dtype would truncate z-scores
+                # to garbage for integer-typed requests
+                x = np.asarray(entry.transform_features(x))
+            # observed/compile-accounting key = the POST-transform batch the
+            # model actually sees: warmup() replays these, so a hot-swapped
+            # version compiles the executable dispatch will really use (a
+            # raw-request key would warm an executable serving never runs
+            # whenever the transform changes the dtype)
+            key = ((tuple(x.shape[1:]), str(x.dtype)), bucket)
+            with self._obs_lock:
+                first_dispatch = key not in self.observed
             dispatch_span = tracer.start_span(
                 "dispatch", parent=batch_span, bucket=bucket, rows=rows,
                 compiled=first_dispatch)
             t0 = monotonic_s()
             out = np.asarray(model.output(x))
             dispatch_ms = (monotonic_s() - t0) * 1000.0
+            if entry.transform is not None:
+                # regression models fitted with fit_labels=True predict in
+                # normalized label space; un-normalize so clients receive
+                # real-unit values (no-op for feature-only normalizers)
+                out = np.asarray(entry.revert_outputs(out))
             dispatch_span.set_attribute("version", version).end()
         except Exception as e:
             self.metrics.errors.add(len(batch))

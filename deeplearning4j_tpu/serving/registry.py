@@ -6,12 +6,24 @@ ModelSerializer zip), then `deploy`d: the warm-up callable runs the NEW
 model's inference on every observed (bucket, feature-shape) so its XLA
 executables are compiled BEFORE the atomic pointer swap — the old version
 keeps serving the whole time, and in-flight batches dispatched against the
-old snapshot complete on it (the batcher reads `(version, model)` once per
+old snapshot complete on it (the batcher reads one registry snapshot per
 batch, so a batch never mixes versions). `rollback` redeploys the previous
 active version the same way.
+
+Persistence: `ModelRegistry(scan_dir=...)` loads every ModelSerializer zip
+in the directory at startup (version = file stem), and `deploy`ing a name
+that is not registered yet falls back to `<scan_dir>/<name>.zip` — so
+`POST /deploy {"version": "m2"}` works by name across server restarts.
+
+Preprocessing travels WITH the model: a zip's `normalizer.json` (see
+etl.normalizer / ModelSerializer.restore_normalizer) becomes the version's
+`transform`, which the batcher applies to every feature batch before the
+forward pass — serving input normalization is a property of the deployed
+version, not of the server.
 """
 from __future__ import annotations
 
+import os
 import threading
 
 from ..util.concurrency import AtomicCounter
@@ -25,14 +37,32 @@ class NoModelDeployed(RuntimeError):
 
 
 class ModelVersion:
-    def __init__(self, version, model, path=None, fmt=None):
+    def __init__(self, version, model, path=None, fmt=None, transform=None):
         self.version = str(version)
         self.model = model
         self.path = str(path) if path is not None else None
         self.fmt = fmt                       # zip format.json, when file-backed
+        self.transform = transform           # e.g. a fitted DataNormalizer
         self.loaded_at = now_s()
         self.deployed_at = None
         self.serve_count = AtomicCounter()   # rows served by this version
+
+    def transform_features(self, x):
+        """Version-owned preprocessing of a raw feature batch (identity when
+        the model shipped without a normalizer)."""
+        if self.transform is None:
+            return x
+        if hasattr(self.transform, "transform_features"):
+            return self.transform.transform_features(x)
+        return self.transform(x)
+
+    def revert_outputs(self, y):
+        """Un-normalize model outputs for normalizers fitted with
+        fit_labels=True (regression label space); identity otherwise."""
+        if self.transform is None or not hasattr(self.transform,
+                                                 "revert_labels"):
+            return y
+        return self.transform.revert_labels(y)
 
     def info(self, active_version=None):
         return {
@@ -40,6 +70,8 @@ class ModelVersion:
             "model_class": type(self.model).__name__,
             "path": self.path,
             "format": self.fmt,
+            "normalizer": type(self.transform).__name__
+            if self.transform is not None else None,
             "loaded_at": self.loaded_at,
             "deployed_at": self.deployed_at,
             "serve_count": self.serve_count.get(),
@@ -48,20 +80,60 @@ class ModelVersion:
 
 
 class ModelRegistry:
-    def __init__(self):
+    def __init__(self, scan_dir=None):
         self._versions = {}
         self._active = None           # version string
         self._history = []            # previously active versions, for rollback
         self._lock = threading.Lock()
         self._deploy_lock = threading.Lock()  # serializes deploy/rollback
+        self.scan_dir = str(scan_dir) if scan_dir is not None else None
+        self.scan_errors = {}         # {filename: error} from directory scans
+        if self.scan_dir is not None:
+            self.scan()
+
+    # ---- persistent directory ---------------------------------------------
+    def scan(self):
+        """Load every ModelSerializer zip in `scan_dir` not registered yet
+        (version = file stem, sorted for deterministic registration order).
+        Returns the newly registered versions.
+
+        One unreadable zip (truncated save, foreign file) must not abort the
+        whole scan — and with scan() running in __init__, must not prevent
+        the server from starting with the healthy models. Failures are
+        recorded in `scan_errors` instead."""
+        if self.scan_dir is None:
+            return []
+        loaded = []
+        for fname in sorted(os.listdir(self.scan_dir)):
+            if not fname.endswith(".zip"):
+                continue
+            version = fname[:-len(".zip")]
+            with self._lock:
+                known = version in self._versions
+            if not known:
+                try:
+                    self.load(version, os.path.join(self.scan_dir, fname))
+                except Exception as e:
+                    self.scan_errors[fname] = f"{type(e).__name__}: {e}"
+                    continue
+                self.scan_errors.pop(fname, None)
+                loaded.append(version)
+        return loaded
+
+    def _scan_path(self, version):
+        """<scan_dir>/<version>.zip when it exists, else None."""
+        if self.scan_dir is None:
+            return None
+        p = os.path.join(self.scan_dir, f"{version}.zip")
+        return p if os.path.isfile(p) else None
 
     # ---- registration -----------------------------------------------------
-    def register(self, version, model, path=None, fmt=None):
+    def register(self, version, model, path=None, fmt=None, transform=None):
         with self._lock:
             if str(version) in self._versions:
                 raise ValueError(f"version {version!r} already registered")
             self._versions[str(version)] = ModelVersion(version, model, path,
-                                                        fmt)
+                                                        fmt, transform)
         return str(version)
 
     def unregister(self, version):
@@ -76,10 +148,13 @@ class ModelRegistry:
 
     def load(self, version, path):
         """Load a ModelSerializer zip (type-sniffed) and register it with the
-        zip's format metadata (model class, dtype, framework)."""
+        zip's format metadata (model class, dtype, framework) and its fitted
+        normalizer (applied to every batch served by this version)."""
         fmt = ModelSerializer.read_format(path)
         model = ModelSerializer.restore(path, load_updater=False)
-        return self.register(version, model, path=path, fmt=fmt)
+        normalizer = ModelSerializer.restore_normalizer(path)
+        return self.register(version, model, path=path, fmt=fmt,
+                             transform=normalizer)
 
     # ---- serving-side reads ------------------------------------------------
     def active(self):
@@ -88,6 +163,15 @@ class ModelRegistry:
             if self._active is None:
                 raise NoModelDeployed("no model deployed")
             return self._active, self._versions[self._active].model
+
+    def active_entry(self) -> ModelVersion:
+        """The full active ModelVersion (model + transform) as ONE snapshot —
+        what the batcher dispatches against, so a hot-swap can never pair
+        version A's model with version B's normalizer."""
+        with self._lock:
+            if self._active is None:
+                raise NoModelDeployed("no model deployed")
+            return self._versions[self._active]
 
     @property
     def active_version(self):
@@ -113,9 +197,22 @@ class ModelRegistry:
     def deploy(self, version, warmup=None):
         """Atomically make `version` the serving model. `warmup(model)` runs
         BEFORE the swap (old version serves until it completes), so steady
-        state never sees a cold executable. Returns the previous version."""
+        state never sees a cold executable. Returns the previous version.
+
+        A version that is not registered but exists as `<scan_dir>/
+        <version>.zip` is loaded first — deploy-by-name from the persistent
+        registry directory."""
         version = str(version)
         with self._deploy_lock:
+            with self._lock:
+                known = version in self._versions
+            if not known:
+                spath = self._scan_path(version)   # checked once: the file
+                if spath is not None:              # may vanish concurrently
+                    try:
+                        self.load(version, spath)
+                    except ValueError:
+                        pass    # a concurrent scan() registered it: fine
             with self._lock:
                 if version not in self._versions:
                     raise KeyError(f"unknown version {version!r}")

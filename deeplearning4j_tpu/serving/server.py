@@ -46,9 +46,12 @@ class ServingServer(BackgroundHttpServer):
                  max_latency_ms=5.0, queue_capacity=256,
                  default_timeout_ms=None, stats_router=None,
                  session_id="serving", router_interval_s=10.0,
-                 transform=None, tracer=None):
+                 transform=None, tracer=None, scan_dir=None):
+        # scan_dir: persistent registry directory — every ModelSerializer zip
+        # in it is loaded at startup and POST /deploy accepts any model name
+        # from it (see ModelRegistry.scan / deploy-by-name)
         super().__init__(host=host, port=port)
-        self.registry = registry or ModelRegistry()
+        self.registry = registry or ModelRegistry(scan_dir=scan_dir)
         if model is not None:
             self.registry.register(version, model)
             self.registry.deploy(version)

@@ -21,6 +21,12 @@ Four cooperating parts, one import surface:
 
 `TelemetryListener` flushes the registry into the existing ui/storage
 router tier so the UI can tail live metrics like training stats.
+
+The ETL subsystem (deeplearning4j_tpu/etl) instruments through this layer
+too: per-stage spans (etl_read/etl_transform), `etl_batches_total` /
+`etl_records_total`, the `etl_queue_depth` gauge, and the
+`etl_consumer_wait_ms` histogram — the device-starvation signal (prefetch
+working = consumer wait ~0).
 """
 from .listener import TelemetryListener, TelemetryReport
 from .prometheus import CONTENT_TYPE as PROMETHEUS_CONTENT_TYPE

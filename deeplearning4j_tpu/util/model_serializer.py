@@ -26,6 +26,7 @@ COEFFICIENTS_ENTRY = "coefficients.bin"
 UPDATER_ENTRY = "updaterState.bin"
 FORMAT_ENTRY = "format.json"
 STATE_ENTRY = "state.bin"
+NORMALIZER_ENTRY = "normalizer.json"
 
 
 def _flatten_tree(tree, prefix=""):
@@ -72,7 +73,11 @@ def _rebuild_like(template, flat, prefix=""):
 
 class ModelSerializer:
     @staticmethod
-    def write_model(model, path, save_updater=True):
+    def write_model(model, path, save_updater=True, normalizer=None):
+        """`normalizer` (an etl.DataNormalizer fitted on the training data)
+        rides in the zip as `normalizer.json`, so serving applies the
+        identical preprocessing (reference: ModelSerializer
+        .addNormalizerToModel / restoreNormalizerFromFile)."""
         from ..nn.multilayer.network import MultiLayerNetwork
         from ..nn.graph.graph import ComputationGraph
         is_graph = isinstance(model, ComputationGraph)
@@ -94,7 +99,49 @@ class ModelSerializer:
                 buf = io.BytesIO()
                 np.savez(buf, **arrs)
                 zf.writestr(UPDATER_ENTRY, buf.getvalue())
+            if normalizer is not None:
+                zf.writestr(NORMALIZER_ENTRY, normalizer.to_json())
         return path
+
+    @staticmethod
+    def add_normalizer(path, normalizer):
+        """Append/replace the normalizer entry of an existing model zip
+        (reference: ModelSerializer.addNormalizerToModel). zipfile append
+        mode would duplicate the entry name, so rewrite the archive — into a
+        sibling temp file first, then atomically replace: rewriting in place
+        would truncate the zip before the coefficients are re-written, and a
+        crash mid-rewrite would destroy the trained model."""
+        import os
+        import tempfile
+        with zipfile.ZipFile(path, "r") as zf:
+            entries = [(n, zf.read(n)) for n in zf.namelist()
+                       if n != NORMALIZER_ENTRY]
+        fd, tmp = tempfile.mkstemp(
+            suffix=".zip.tmp", dir=os.path.dirname(os.path.abspath(path)))
+        try:
+            with os.fdopen(fd, "wb") as fh, \
+                    zipfile.ZipFile(fh, "w", zipfile.ZIP_DEFLATED) as zf:
+                for n, data in entries:
+                    zf.writestr(n, data)
+                zf.writestr(NORMALIZER_ENTRY, normalizer.to_json())
+            os.replace(tmp, path)
+        except BaseException:
+            try:
+                os.unlink(tmp)
+            except OSError:
+                pass
+            raise
+        return path
+
+    @staticmethod
+    def restore_normalizer(path):
+        """The zip's fitted DataNormalizer, or None when the model was saved
+        without one (reference: ModelSerializer.restoreNormalizerFromFile)."""
+        from ..etl.normalizer import DataNormalizer
+        with zipfile.ZipFile(path, "r") as zf:
+            if NORMALIZER_ENTRY not in zf.namelist():
+                return None
+            return DataNormalizer.from_json(zf.read(NORMALIZER_ENTRY).decode())
 
     @staticmethod
     def restore_multi_layer_network(path, load_updater=True):

@@ -90,3 +90,67 @@ def test_prefetch_propagates_producer_error():
         # surfaces from has_next() (iteration protocol) rather than being lost
         for _ in it:
             pass
+
+
+class _BoomOnce(SlowIterator):
+    """Reader that fails mid-stream on the first pass only."""
+
+    def __init__(self, n_batches, boom_at=1):
+        super().__init__(n_batches, 0.0)
+        self.boom_at = boom_at
+        self._armed = True
+
+    def next(self):
+        if self._armed and self._i == self.boom_at:
+            raise RuntimeError("decode failed")
+        return super().next()
+
+    def reset(self):
+        super().reset()
+        self._armed = False
+
+
+@pytest.mark.parametrize("cls", [AsyncDataSetIterator, DevicePrefetchIterator])
+def test_prefetch_error_surfaces_on_close_exactly_once(cls):
+    """A worker error raised AFTER the consumer stops calling next() used to
+    be swallowed; close() must re-raise it — and exactly once."""
+    import time as _time
+    it = cls(_BoomOnce(4), queue_size=4)
+    it.next()                       # consume one batch, then stop pulling
+    deadline = _time.monotonic() + 20
+    while it._error is None and _time.monotonic() < deadline:
+        _time.sleep(0.01)           # worker hits the failure in background
+    with pytest.raises(RuntimeError, match="decode failed"):
+        it.close()
+    it.close()                      # second close: clean no-op
+    assert not it.has_next()        # and no third surfacing from has_next
+
+
+@pytest.mark.parametrize("cls", [AsyncDataSetIterator, DevicePrefetchIterator])
+def test_prefetch_error_surfaces_on_reset_exactly_once(cls):
+    """reset() after a mid-stream failure re-raises the pending error once,
+    and the restarted pass (underlying reset cleared the fault) runs clean."""
+    import time as _time
+    it = cls(_BoomOnce(4), queue_size=4)
+    it.next()
+    deadline = _time.monotonic() + 20
+    while it._error is None and _time.monotonic() < deadline:
+        _time.sleep(0.01)
+    with pytest.raises(RuntimeError, match="decode failed"):
+        it.reset()
+    # the raise happened AFTER the restart: the iterator is usable again
+    assert sum(1 for _ in it) == 4
+    it.close()
+
+
+@pytest.mark.parametrize("cls", [AsyncDataSetIterator, DevicePrefetchIterator])
+def test_prefetch_error_not_raised_twice_across_paths(cls):
+    """The iteration path (has_next raise) claims the error; reset()/close()
+    afterwards must NOT raise the same error again."""
+    it = cls(_BoomOnce(4), queue_size=4)
+    with pytest.raises(RuntimeError, match="decode failed"):
+        for _ in it:
+            pass
+    it.reset()                      # no second raise; restarts cleanly
+    assert sum(1 for _ in it) == 4
+    it.close()

@@ -143,6 +143,35 @@ def test_image_record_reader_and_training(tmp_path):
     assert net.evaluate(it).accuracy() > 0.9
 
 
+def test_image_record_reader_label_indices_deterministic(tmp_path, monkeypatch):
+    """Regression: the class-subdirectory -> label-index mapping (and the
+    file order within each class) must not depend on filesystem enumeration
+    order — os.listdir order is explicitly arbitrary and differs across
+    filesystems. Scrambling listdir must change nothing."""
+    for d in ("zebra", "ant", "mouse"):
+        os.makedirs(tmp_path / d)
+        for f in ("3.png", "1.png", "2.png"):
+            (tmp_path / d / f).touch()     # initialize() only scans names
+    (tmp_path / "notes.txt").touch()       # non-directory entries ignored
+
+    reader = ImageRecordReader(height=4, width=4, channels=1)
+    reader.initialize(str(tmp_path))
+    baseline = (list(reader.labels), list(reader._items))
+
+    real_listdir = os.listdir
+
+    def scrambled(path):
+        return list(reversed(sorted(real_listdir(path))))
+
+    monkeypatch.setattr(os, "listdir", scrambled)
+    reader2 = ImageRecordReader(height=4, width=4, channels=1)
+    reader2.initialize(str(tmp_path))
+    monkeypatch.undo()
+
+    assert reader2.labels == ["ant", "mouse", "zebra"]
+    assert (list(reader2.labels), list(reader2._items)) == baseline
+
+
 # -------------------------------------------------------------- sequences
 
 def test_sequence_two_reader_classification(tmp_path):

@@ -809,3 +809,193 @@ def test_smoke_serving_heavy():
     summary = smoke.run(n_requests=200, concurrency=16,
                         p99_budget_ms=10000.0)
     assert summary["errors"] == [] and summary["shed"] == 0
+
+
+# ------------------------------------------------ persistent registry (ETL)
+
+def test_registry_scan_dir_loads_zips_and_deploys_by_name(tmp_path):
+    """ModelRegistry(scan_dir=...) loads every ModelSerializer zip at
+    startup (version = file stem), and deploy() falls back to
+    <scan_dir>/<name>.zip for names registered after startup — the
+    persistent-registry ROADMAP item."""
+    net_a, net_b = _net(seed=0), _net(seed=1)
+    ModelSerializer.write_model(net_a, str(tmp_path / "alpha.zip"))
+    ModelSerializer.write_model(net_b, str(tmp_path / "beta.zip"))
+    registry = ModelRegistry(scan_dir=str(tmp_path))
+    assert {v["version"] for v in registry.versions()} == {"alpha", "beta"}
+
+    registry.deploy("alpha")
+    assert registry.active_version == "alpha"
+    # a zip dropped into the directory AFTER startup deploys by bare name
+    net_c = _net(seed=2)
+    ModelSerializer.write_model(net_c, str(tmp_path / "gamma.zip"))
+    registry.deploy("gamma")
+    assert registry.active_version == "gamma"
+    x = np.random.default_rng(0).normal(size=(2, 6)).astype(np.float32)
+    np.testing.assert_allclose(
+        np.asarray(registry.active()[1].output(x)),
+        np.asarray(net_c.output(x)), rtol=1e-6)
+    # unknown names (no zip either) still fail loudly
+    with pytest.raises(KeyError):
+        registry.deploy("missing")
+    # rescan registers without deploying
+    ModelSerializer.write_model(_net(seed=3), str(tmp_path / "delta.zip"))
+    assert registry.scan() == ["delta"]
+    assert registry.active_version == "gamma"
+
+
+def test_serving_server_scan_dir_deploy_by_name_over_http(tmp_path):
+    ModelSerializer.write_model(_net(seed=5), str(tmp_path / "m1.zip"))
+    server = ServingServer(scan_dir=str(tmp_path), port=0).start()
+    try:
+        req = urllib.request.Request(
+            server.url + "/deploy",
+            data=json.dumps({"version": "m1"}).encode())
+        with urllib.request.urlopen(req, timeout=60) as r:
+            assert json.loads(r.read())["active"] == "m1"
+        x = np.zeros((1, 6), np.float32)
+        req = urllib.request.Request(
+            server.url + "/predict",
+            data=json.dumps({"data": x.tolist()}).encode())
+        with urllib.request.urlopen(req, timeout=60) as r:
+            assert json.loads(r.read())["version"] == "m1"
+    finally:
+        server.stop()
+
+
+def test_zip_normalizer_auto_applied_on_predict(tmp_path):
+    """Acceptance (ETL): a normalizer saved in the model zip is auto-applied
+    by ServingServer /predict — raw client features, normalized model
+    inputs, identical preprocessing to training."""
+    from deeplearning4j_tpu import NormalizerStandardize
+    from deeplearning4j_tpu.datasets.dataset import DataSet
+    rng = np.random.default_rng(7)
+    raw = rng.normal(50.0, 20.0, size=(64, 6)).astype(np.float32)
+    nz = NormalizerStandardize().fit(DataSet(raw, raw))
+    net = _net(seed=0)
+    zip_path = str(tmp_path / "norm.zip")
+    ModelSerializer.write_model(net, zip_path, normalizer=nz)
+
+    registry = ModelRegistry()
+    registry.load("v1", zip_path)
+    assert registry.get("v1").info()["normalizer"] == "NormalizerStandardize"
+    server = ServingServer(registry=registry, port=0).start()
+    try:
+        server.deploy("v1")
+        x = raw[:3]
+        res = server.predict(x)
+        expected = np.asarray(net.output(nz.transform_features(x)))
+        np.testing.assert_allclose(res["prediction"], expected,
+                                   rtol=1e-5, atol=1e-6)
+        # and NOT the un-normalized forward
+        assert not np.allclose(res["prediction"], np.asarray(net.output(x)),
+                               atol=1e-3)
+        # HTTP path agrees with the programmatic path
+        req = urllib.request.Request(
+            server.url + "/predict",
+            data=json.dumps({"data": x.tolist()}).encode())
+        with urllib.request.urlopen(req, timeout=60) as r:
+            http_out = json.loads(r.read())["prediction"]
+        np.testing.assert_allclose(http_out, expected, rtol=1e-4, atol=1e-5)
+    finally:
+        server.stop()
+
+
+def test_hot_swap_cannot_mix_model_and_normalizer(tmp_path):
+    """The batcher dispatches against ONE ModelVersion snapshot: version A's
+    model can never run with version B's normalizer mid-swap."""
+    from deeplearning4j_tpu import NormalizerMinMaxScaler
+    from deeplearning4j_tpu.datasets.dataset import DataSet
+    x = np.linspace(0.0, 10.0, 60, dtype=np.float32).reshape(10, 6)
+    nz = NormalizerMinMaxScaler().fit(DataSet(x, x))
+    net = _net(seed=0)
+    p1 = str(tmp_path / "n1.zip")
+    ModelSerializer.write_model(net, p1, normalizer=nz)
+    registry = ModelRegistry()
+    registry.load("v1", p1)
+    registry.register("v2", StubModel(1.0))       # no normalizer at all
+    server = _component_server(None, registry=registry)
+    try:
+        registry.deploy("v1")
+        out1 = server.predict(x[:2])["prediction"]
+        np.testing.assert_allclose(
+            out1, np.asarray(net.output(nz.transform_features(x[:2]))),
+            rtol=1e-5, atol=1e-6)
+        registry.deploy("v2")
+        out2 = server.predict(x[:2])["prediction"]
+        np.testing.assert_allclose(out2, x[:2], rtol=1e-6)  # raw passthrough
+    finally:
+        server.stop()
+
+
+def test_normalizer_applied_to_integer_typed_request(tmp_path):
+    """Regression: the batcher must not cast the normalized (float) batch
+    back to an integer request dtype — z-scores truncated to int are
+    garbage. Programmatic submits can carry int arrays."""
+    from deeplearning4j_tpu import NormalizerStandardize
+    from deeplearning4j_tpu.datasets.dataset import DataSet
+    raw = np.arange(60, dtype=np.float32).reshape(10, 6) * 7 + 3
+    nz = NormalizerStandardize().fit(DataSet(raw, raw))
+    net = _net(seed=0)
+    p = str(tmp_path / "n.zip")
+    ModelSerializer.write_model(net, p, normalizer=nz)
+    registry = ModelRegistry()
+    registry.load("v1", p)
+    server = _component_server(None, registry=registry)
+    try:
+        registry.deploy("v1")
+        x_int = np.asarray(raw[:2], np.int64)    # integer-typed request
+        out = server.predict(x_int)["prediction"]
+        expected = np.asarray(net.output(
+            nz.transform_features(x_int.astype(np.float32))))
+        np.testing.assert_allclose(out, expected, rtol=1e-5, atol=1e-6)
+    finally:
+        server.stop()
+
+
+def test_fit_labels_normalizer_reverts_served_predictions(tmp_path):
+    """Regression: a regression model trained against NORMALIZED labels
+    (fit_labels=True) predicts in z-score label space; serving must revert
+    its outputs to real units."""
+    from deeplearning4j_tpu import NormalizerStandardize
+    from deeplearning4j_tpu.datasets.dataset import DataSet
+    rng = np.random.default_rng(0)
+    x = rng.normal(size=(64, 6)).astype(np.float32)
+    y = (x.sum(axis=1, keepdims=True) * 100.0 + 500.0).astype(np.float32)
+    nz = NormalizerStandardize(fit_labels=True).fit(DataSet(x, y))
+    # a stub "perfect model" that predicts the NORMALIZED label exactly
+    norm_y = nz.transform(DataSet(x, y)).labels
+
+    class Oracle:
+        def output(self, xx):
+            # match rows of the padded batch back to known inputs; pad rows
+            # (zeros) predict 0 in normalized space
+            out = np.zeros((xx.shape[0], 1), np.float32)
+            for i in range(xx.shape[0]):
+                hit = np.where((np.abs(
+                    nz.transform_features(x) - xx[i]).sum(axis=1)) < 1e-4)[0]
+                if hit.size:
+                    out[i] = norm_y[hit[0]]
+            return out
+
+    registry = ModelRegistry()
+    registry.register("v1", Oracle(), transform=nz)
+    server = _component_server(None, registry=registry)
+    try:
+        registry.deploy("v1")
+        out = server.predict(x[:3])["prediction"]
+        np.testing.assert_allclose(out, y[:3], rtol=1e-3, atol=1e-2)
+    finally:
+        server.stop()
+
+
+def test_scan_dir_skips_unreadable_zip(tmp_path):
+    """Regression: one truncated/foreign zip in scan_dir must not prevent
+    the registry (and thus the server) from starting with healthy models."""
+    ModelSerializer.write_model(_net(seed=0), str(tmp_path / "good.zip"))
+    (tmp_path / "broken.zip").write_bytes(b"this is not a zip")
+    registry = ModelRegistry(scan_dir=str(tmp_path))
+    assert {v["version"] for v in registry.versions()} == {"good"}
+    assert "broken.zip" in registry.scan_errors
+    registry.deploy("good")
+    assert registry.active_version == "good"
