@@ -104,6 +104,15 @@ class _DeliveryBuffer:
         with self._cv:
             return self._delivered
 
+    def undelivered(self):
+        """Chunks the reader produced that are neither delivered nor
+        buffered, or None while the reader is still running."""
+        with self._cv:
+            if self._total is None:
+                return None
+            return (self._total - self._delivered
+                    - len(self._items) - len(self._fifo))
+
     def take(self):
         """Next item in delivery order; _END when the stream is complete.
         Raises a parked worker/reader error exactly once."""
@@ -167,7 +176,7 @@ class ParallelPipelineExecutor(DataSetIterator):
                  ordered=True, queue_capacity=4, normalizer=None,
                  label_columns=None, one_hot_labels=None, assemble=None,
                  drop_remainder=False, name="etl", registry=None,
-                 tracer=None):
+                 tracer=None, health=None):
         self.reader = reader
         self.transform = transform
         self.batch_size = int(batch_size)
@@ -214,7 +223,20 @@ class ParallelPipelineExecutor(DataSetIterator):
             self.final_schema = None
         self._started = False
         self._consumed_any = False
+        # deep-health probe: the pipeline shows up as a component on
+        # /healthz (process-default HealthMonitor unless one is passed) —
+        # unhealthy when a worker/reader error is parked, degraded when a
+        # pipeline thread died without reporting
+        if health is None:
+            from ..telemetry.health import get_monitor
+            health = get_monitor()
+        self.health = health
         self._start()
+        # atomic unique key: two pipelines sharing the default name must
+        # not overwrite each other's probe (or unregister the survivor's)
+        self._health_key = health.register_unique(f"etl:{self.name}",
+                                                  self._health_probe)
+        self._health_registered = True
 
     # ---- pipeline threads --------------------------------------------------
     def _start(self):
@@ -297,6 +319,24 @@ class ParallelPipelineExecutor(DataSetIterator):
         if self.workers > 0:
             self._m_depth.set(self._work.size() + self._out.depth(),
                               pipeline=self.name)
+
+    def _health_probe(self):
+        if self.workers <= 0:
+            return "healthy", {"mode": "inline"}
+        if self._out.has_error():
+            return "unhealthy", {"reason": "pipeline error pending"}
+        dead = [t.name for t in self._threads if not t.is_alive()]
+        if len(dead) == len(self._threads) and not self._done \
+                and not self._stop.is_set():
+            # all threads exiting is fine once everything the reader
+            # produced is delivered or buffered; anything short of that
+            # with no parked error means the pipeline died silently
+            undelivered = self._out.undelivered()
+            if undelivered is None or undelivered > 0:
+                return "degraded", {"reason": "pipeline threads exited",
+                                    "dead": dead}
+        return "healthy", {"depth": self._out.depth(),
+                           "delivered": self._out.delivered()}
 
     # ---- records -> DataSet ------------------------------------------------
     def _process(self, records):
@@ -399,6 +439,9 @@ class ParallelPipelineExecutor(DataSetIterator):
         err = self._shutdown()
         self._done = True
         self._peek = None
+        if self._health_registered:
+            self.health.unregister(self._health_key)
+            self._health_registered = False
         if err is not None:
             raise err
 
@@ -409,5 +452,12 @@ class ParallelPipelineExecutor(DataSetIterator):
         err = self._shutdown()
         self.reader.reset()
         self._start()
+        if not self._health_registered:
+            # a close()d-then-reset() pipeline is live again: restore its
+            # health coverage under a fresh unique key (testing membership
+            # of the OLD key could adopt another same-name pipeline's probe)
+            self._health_key = self.health.register_unique(
+                f"etl:{self.name}", self._health_probe)
+            self._health_registered = True
         if err is not None:
             raise err

@@ -53,9 +53,12 @@ class PerformanceListener(IterationListener):
         self._last_time = None
         self._last_iter = 0
         self._samples_since = 0
-        self.last_samples_per_sec = float("nan")
-        self.last_batches_per_sec = float("nan")
-        self.last_iteration_ms = float("nan")
+        # None (not NaN) until the first measured interval: a snapshot
+        # serialized before any measurement must emit null, never a bare
+        # NaN token that JSON.parse rejects
+        self.last_samples_per_sec = None
+        self.last_batches_per_sec = None
+        self.last_iteration_ms = None
         # central-registry mirror (telemetry.MetricsRegistry): the same
         # throughput numbers this listener logs become scrapeable gauges and
         # a latency histogram instead of private fields only
@@ -151,6 +154,9 @@ class ComposableIterationListener(IterationListener):
     def on_epoch_end(self, model):
         for l in self.listeners:
             l.on_epoch_end(model)
+
+
+from .health import TrainingHalted, TrainingHealthListener  # noqa: E402
 
 
 def resolve_listeners(listeners):

@@ -1,0 +1,191 @@
+"""TrainingHealthListener: the training-run watchdog.
+
+A NaN loss used to train silently to completion — every iteration after the
+first non-finite update is wasted accelerator time, and the checkpoint
+driver would happily persist the corpse. This listener watches each
+iteration for:
+
+- **NaN/Inf loss** (always) and, with `check_gradients=True`, NaN/Inf in
+  the gradient pytree (costs a device sync per iteration — opt-in);
+- **loss divergence**: loss > `divergence_factor` x rolling best (+ a small
+  absolute margin so near-zero losses don't flap), held for
+  `divergence_patience` consecutive iterations;
+- **step-time regression**: the recent median iteration wall time exceeds
+  `step_time_factor` x the baseline median established over the first
+  window (a quiet way to notice thermal throttling, host contention, or an
+  accidentally-recompiling step).
+
+Each detection increments a registry counter (`training_nan_total`,
+`training_divergence_total`, `training_step_time_regressions_total`) — the
+series AlertEngine's `default_training_rules()` fire on — logs a structured
+record inside the current iteration span (so /logs correlates with /trace),
+and reports through a HealthMonitor as the `trainer` component. Fatal
+conditions (per `halt_on`) additionally arm `should_halt`, which
+FaultTolerantTrainer checks every batch to checkpoint-and-halt instead of
+burning TPU hours on a dead run.
+"""
+from __future__ import annotations
+
+import collections
+import math
+import statistics
+
+from . import IterationListener
+from ...util.time_source import monotonic_s
+
+
+class TrainingHalted(RuntimeError):
+    """Raised by FaultTolerantTrainer when its health listener trips a
+    fatal condition; carries the reason and the final checkpoint path."""
+
+    def __init__(self, reason, iteration, checkpoint_path=None):
+        super().__init__(
+            f"training halted at iteration {iteration}: {reason}"
+            + (f" (checkpoint: {checkpoint_path})" if checkpoint_path else ""))
+        self.reason = reason
+        self.iteration = iteration
+        self.checkpoint_path = checkpoint_path
+
+
+class TrainingHealthListener(IterationListener):
+    FATAL = ("nan_loss", "nan_gradient", "divergence")
+
+    def __init__(self, *, health=None, registry=None, logger=None,
+                 component="trainer", check_gradients=False,
+                 divergence_factor=10.0, divergence_margin=1.0,
+                 divergence_patience=3, step_time_factor=3.0,
+                 step_time_window=20, halt_on=FATAL):
+        if registry is None:
+            from ...telemetry.registry import get_registry
+            registry = get_registry()
+        if logger is None:
+            from ...telemetry.logging import get_logger
+            logger = get_logger()
+        self.health = health
+        self.logger = logger
+        self.component = str(component)
+        self.check_gradients = bool(check_gradients)
+        self.wants_gradients = self.check_gradients  # keep grads on device
+        self.divergence_factor = float(divergence_factor)
+        self.divergence_margin = float(divergence_margin)
+        self.divergence_patience = max(1, int(divergence_patience))
+        self.step_time_factor = float(step_time_factor)
+        self.step_time_window = max(2, int(step_time_window))
+        self.halt_on = tuple(halt_on)
+        self._nan = registry.counter(
+            "training_nan_total", "Non-finite loss/gradient detections")
+        self._div = registry.counter(
+            "training_divergence_total", "Loss-divergence detections")
+        self._regress = registry.counter(
+            "training_step_time_regressions_total",
+            "Step-time regression detections")
+        # run state
+        self.best_loss = None
+        self.last_loss = None
+        self.last_iteration = 0
+        self._diverged_streak = 0
+        self._last_mono = None
+        self._baseline_times = []          # first window of step times
+        self._recent_times = collections.deque(maxlen=self.step_time_window)
+        self.step_time_regressed = False
+        self.trip_reason = None            # first fatal condition seen
+        if self.health is not None:
+            self.health.register(self.component, self._probe)
+
+    # ---- watchdog ----------------------------------------------------------
+    @property
+    def should_halt(self):
+        return self.trip_reason is not None and self.trip_reason in self.halt_on
+
+    def _trip(self, reason, iteration, **fields):
+        """First fatal detection only: a persistent NaN must not log one
+        error per subsequent iteration (evicting the /logs ring of the
+        context around the blow-up) — returns whether this call tripped."""
+        if self.trip_reason is not None:
+            return False
+        self.trip_reason = reason
+        self.logger.error(f"training_{reason}", component=self.component,
+                          iteration=iteration, **fields)
+        return True
+
+    def iteration_done(self, model, iteration):
+        self.last_iteration = iteration
+        self._observe_step_time(iteration)
+        try:
+            loss = float(model.score_value)
+        except (TypeError, ValueError):
+            loss = None
+        if loss is not None:
+            self.last_loss = loss
+            if not math.isfinite(loss):
+                if self._trip("nan_loss", iteration, loss=loss):
+                    self._nan.inc(1)    # one detection, not one per step
+            else:
+                self._check_divergence(loss, iteration)
+        if self.check_gradients and self.trip_reason is None:
+            self._check_gradients(model, iteration)
+
+    def _check_divergence(self, loss, iteration):
+        if self.best_loss is None or loss < self.best_loss:
+            self.best_loss = loss
+            self._diverged_streak = 0
+            return
+        bound = self.best_loss * self.divergence_factor \
+            if self.best_loss > 0 else 0.0
+        if loss > bound + self.divergence_margin:
+            self._diverged_streak += 1
+            if self._diverged_streak >= self.divergence_patience:
+                if self._trip("divergence", iteration, loss=loss,
+                              best=self.best_loss):
+                    self._div.inc(1)
+        else:
+            self._diverged_streak = 0
+
+    def _check_gradients(self, model, iteration):
+        grads = getattr(model, "last_gradients", None)
+        if grads is None:
+            return
+        import jax
+        import numpy as np
+        for leaf in jax.tree_util.tree_leaves(grads):
+            if not bool(np.all(np.isfinite(np.asarray(leaf)))):
+                if self._trip("nan_gradient", iteration):
+                    self._nan.inc(1)
+                return
+
+    def _observe_step_time(self, iteration):
+        now = monotonic_s()
+        if self._last_mono is None:
+            self._last_mono = now
+            return
+        dt_ms = (now - self._last_mono) * 1000.0
+        self._last_mono = now
+        if len(self._baseline_times) < self.step_time_window:
+            self._baseline_times.append(dt_ms)
+            return
+        self._recent_times.append(dt_ms)
+        if len(self._recent_times) < self._recent_times.maxlen:
+            return
+        baseline = statistics.median(self._baseline_times)
+        recent = statistics.median(self._recent_times)
+        regressed = baseline > 0 and recent > self.step_time_factor * baseline
+        if regressed and not self.step_time_regressed:
+            self._regress.inc(1)
+            self.logger.warning("training_step_time_regression",
+                                component=self.component,
+                                iteration=iteration,
+                                baseline_ms=baseline, recent_ms=recent)
+        self.step_time_regressed = regressed
+
+    # ---- health probe ------------------------------------------------------
+    def _probe(self):
+        detail = {"iteration": self.last_iteration,
+                  "last_loss": self.last_loss, "best_loss": self.best_loss}
+        if self.trip_reason is not None:
+            return "unhealthy", {**detail, "reason": self.trip_reason}
+        if self.step_time_regressed or self._diverged_streak:
+            return "degraded", {**detail,
+                                "reason": ("step_time_regression"
+                                           if self.step_time_regressed
+                                           else "loss_rising")}
+        return "healthy", detail

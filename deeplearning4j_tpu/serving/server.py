@@ -17,7 +17,15 @@ Endpoints (all JSON unless noted, shared stdlib plumbing from util/http.py):
   GET  /trace     -> Chrome-trace/Perfetto JSON of recent spans (each
                   /predict produces a predict -> admission/batch -> dispatch
                   span tree)
-  GET  /healthz   -> {"status", "served", "queue_depth", "active_version"}
+  GET  /healthz   -> deep health: {"status", "health", "components": {name:
+                  {"status", detail...}}, "served", "queue_depth",
+                  "active_version"}; HTTP 503 when any component probe
+                  (admission queue, batcher thread, model registry, plus
+                  anything registered on server.health) reports unhealthy
+  GET  /alerts    -> AlertEngine state: every rule with its
+                  pending/firing/resolved lifecycle position and last value
+  GET  /logs      -> bounded ring of structured log records
+                  (?level=error&n=100&trace_id=N), trace/span-correlated
 """
 from __future__ import annotations
 
@@ -33,6 +41,10 @@ from .admission import (AdmissionQueue, DeadlineExceeded, RejectedError,
 from .batcher import DynamicBatcher
 from .metrics import ServingMetrics
 from .registry import ModelRegistry, NoModelDeployed
+from ..telemetry.alerts import (AlertEngine, RouterAlertSink,
+                                WebhookAlertSink, default_serving_rules)
+from ..telemetry.health import HealthMonitor
+from ..telemetry.logging import StructuredLogger
 from ..telemetry.prometheus import CONTENT_TYPE as PROMETHEUS_CONTENT_TYPE
 from ..telemetry.trace import Tracer
 from ..telemetry.xla import CompileTracker, register_device_memory_gauges
@@ -46,7 +58,9 @@ class ServingServer(BackgroundHttpServer):
                  max_latency_ms=5.0, queue_capacity=256,
                  default_timeout_ms=None, stats_router=None,
                  session_id="serving", router_interval_s=10.0,
-                 transform=None, tracer=None, scan_dir=None):
+                 transform=None, tracer=None, scan_dir=None,
+                 alert_rules=None, alert_sinks=None, alert_webhook=None,
+                 alert_interval_s=5.0, log_sinks=None):
         # scan_dir: persistent registry directory — every ModelSerializer zip
         # in it is loaded at startup and POST /deploy accepts any model name
         # from it (see ModelRegistry.scan / deploy-by-name)
@@ -79,6 +93,57 @@ class ServingServer(BackgroundHttpServer):
         self._router_flush_lock = threading.Lock()
         self._final_flush_done = False
         self.transform = transform
+        # health & alerting tier: structured logs (GET /logs), deep health
+        # probes (GET /healthz -> 503 when any component is unhealthy), and
+        # rule-driven alerts over this server's registry (GET /alerts)
+        self.logger = StructuredLogger(name=f"serving.{session_id}",
+                                       registry=self.metrics.registry,
+                                       sinks=log_sinks)
+        # instrument-level problems (raising gauge callbacks) log HERE, so
+        # they show on this server's /logs, not a process-global buffer
+        self.metrics.registry.logger = self.logger
+        self.health = HealthMonitor(logger=self.logger)
+        self.health.register("admission", self._probe_admission)
+        self.health.register("batcher", self._probe_batcher)
+        self.health.register("registry", self._probe_registry)
+        rules = default_serving_rules() if alert_rules is None \
+            else list(alert_rules)
+        sinks = list(alert_sinks or [])
+        if alert_webhook is not None:
+            sinks.append(WebhookAlertSink(alert_webhook))
+        if stats_router is not None:
+            sinks.append(RouterAlertSink(stats_router,
+                                         session_id=f"{session_id}-alerts"))
+        self.alerts = AlertEngine(registry=self.metrics.registry,
+                                  rules=rules, sinks=sinks,
+                                  interval_s=alert_interval_s,
+                                  logger=self.logger)
+
+    # ---- health probes -----------------------------------------------------
+    def _probe_admission(self):
+        depth, cap = self.queue.depth(), self.queue.capacity
+        if self.queue.closed:
+            return "unhealthy", {"reason": "draining", "depth": depth}
+        if depth >= 0.8 * cap:
+            return "degraded", {"reason": "near capacity", "depth": depth,
+                                "capacity": cap}
+        return "healthy", {"depth": depth, "capacity": cap}
+
+    def _probe_batcher(self):
+        t = self.batcher._thread
+        if t is None:
+            return "degraded", {"reason": "not started"}
+        if not t.is_alive():
+            return "unhealthy", {"reason": "batcher thread dead"}
+        return "healthy", {}
+
+    def _probe_registry(self):
+        versions = self.registry.versions()
+        if self.registry.active_version is None:
+            return "unhealthy", {"reason": "no model deployed",
+                                 "registered": len(versions)}
+        return "healthy", {"active": self.registry.active_version,
+                           "registered": len(versions)}
 
     # ---- programmatic API --------------------------------------------------
     def submit(self, x, timeout_ms=None):
@@ -249,14 +314,32 @@ class ServingServer(BackgroundHttpServer):
             self.batcher.observed = observed
             self._final_flush_done = False
         self.batcher.start()
+        self.alerts.start()
         server = self
 
         class Handler(QuietHandler):
             def do_GET(self):
                 u = urlparse(self.path)
                 query = {k: v[0] for k, v in parse_qs(u.query).items()}
+                # default=str: probe detail and log fields are free-form
+                # (numpy scalars, exceptions) — stringify, never 500
                 if u.path == "/healthz":
-                    self.send_json(200, server._healthz())
+                    report = server._healthz()
+                    self.send_json(
+                        503 if report["health"] == "unhealthy" else 200,
+                        report, default=str)
+                elif u.path == "/alerts":
+                    self.send_json(200, server.alerts.state(), default=str)
+                elif u.path == "/logs":
+                    try:
+                        payload = server.logger.buffer.to_dict(
+                            level=query.get("level"),
+                            n=int(query.get("n", 256)),
+                            trace_id=query.get("trace_id"))
+                    except ValueError as e:   # ?n=all / ?trace_id=abc -> 400
+                        self.send_json(400, {"error": f"bad query: {e}"})
+                        return
+                    self.send_json(200, payload, default=str)
                 elif u.path == "/models":
                     self.send_json(200, {
                         "models": server.registry.versions(),
@@ -299,6 +382,7 @@ class ServingServer(BackgroundHttpServer):
     def stop(self, drain=True, timeout=30.0):
         """Graceful drain: stop admitting (new requests shed with 429),
         serve everything already queued, then stop the HTTP server."""
+        self.alerts.stop()
         self.queue.close()
         if not drain:
             self.queue.flush_expired_or_fail()
@@ -367,7 +451,15 @@ class ServingServer(BackgroundHttpServer):
                                 "version": res["version"]})
 
     def _healthz(self):
-        return {"status": "ok",
+        """Deep health: aggregate of every registered component probe plus
+        the legacy summary fields. `status` stays "ok" when everything is
+        healthy (back-compat with clients asserting the old constant);
+        `health` always carries the raw healthy/degraded/unhealthy word.
+        The HTTP layer answers 503 only when some component is unhealthy."""
+        h = self.health.check()
+        return {"status": "ok" if h["status"] == "healthy" else h["status"],
+                "health": h["status"],
+                "components": h["components"],
                 "served": self.metrics.rows.get(),
                 "requests": self.metrics.requests.get(),
                 "queue_depth": self.queue.depth(),

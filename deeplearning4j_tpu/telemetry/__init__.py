@@ -22,13 +22,36 @@ Four cooperating parts, one import surface:
 `TelemetryListener` flushes the registry into the existing ui/storage
 router tier so the UI can tail live metrics like training stats.
 
+The health & alerting tier sits on top and closes observe -> detect ->
+react:
+
+- `logging` — structured JSON log records with automatic trace/span-id
+  correlation, a bounded ring buffer (`GET /logs`), pluggable sinks, and
+  `log_events_total{level}`.
+- `health` — `HealthMonitor` aggregating per-component probes (batcher,
+  registry, admission queue, ETL pipelines, trainer) into a deep `/healthz`
+  that answers 503 when any component is unhealthy.
+- `alerts` — `AlertEngine` evaluating declarative threshold / ratio /
+  SLO-burn-rate rules over the registry on a ManualClock-testable interval,
+  with a pending -> firing -> resolved lifecycle and log/webhook/router
+  sinks (`GET /alerts`); `optimize.listeners.TrainingHealthListener` is the
+  training watchdog feeding it (NaN loss/gradients, divergence, step-time
+  regression) and the checkpoint-and-halt trigger for FaultTolerantTrainer.
+
 The ETL subsystem (deeplearning4j_tpu/etl) instruments through this layer
 too: per-stage spans (etl_read/etl_transform), `etl_batches_total` /
 `etl_records_total`, the `etl_queue_depth` gauge, and the
 `etl_consumer_wait_ms` histogram — the device-starvation signal (prefetch
 working = consumer wait ~0).
 """
+from .alerts import (AlertEngine, AlertRule, LogAlertSink, RouterAlertSink,
+                     WebhookAlertSink, default_serving_rules,
+                     default_training_rules)
+from .health import (DEGRADED, HEALTHY, UNHEALTHY, HealthMonitor,
+                     get_monitor, set_monitor)
 from .listener import TelemetryListener, TelemetryReport
+from .logging import (FileJsonSink, LogBuffer, StderrJsonSink,
+                      StructuredLogger, get_logger, set_logger)
 from .prometheus import CONTENT_TYPE as PROMETHEUS_CONTENT_TYPE
 from .prometheus import render as render_prometheus
 from .registry import (Counter, Gauge, Histogram, MetricsRegistry,
@@ -38,7 +61,14 @@ from .trace import (NOOP_SPAN, Span, Tracer, current_span, enable_tracing,
 from .xla import (CompileTracker, record_jit_compile,
                   register_device_memory_gauges, timed_first_call)
 
-__all__ = ["TelemetryListener", "TelemetryReport",
+__all__ = ["AlertEngine", "AlertRule", "LogAlertSink", "RouterAlertSink",
+           "WebhookAlertSink", "default_serving_rules",
+           "default_training_rules",
+           "DEGRADED", "HEALTHY", "UNHEALTHY", "HealthMonitor",
+           "get_monitor", "set_monitor",
+           "FileJsonSink", "LogBuffer", "StderrJsonSink", "StructuredLogger",
+           "get_logger", "set_logger",
+           "TelemetryListener", "TelemetryReport",
            "PROMETHEUS_CONTENT_TYPE", "render_prometheus",
            "Counter", "Gauge", "Histogram", "MetricsRegistry",
            "get_registry",

@@ -1,0 +1,217 @@
+"""Structured JSON logging with automatic trace correlation.
+
+Third telemetry tier (after spans and metrics): every log record is a plain
+dict carrying wall time, level, logger name, message, free-form fields, and
+— when the calling thread is inside a `Tracer` span — the current
+trace_id/span_id, so a `/logs` line can be joined against the `/trace`
+export without any manual plumbing (the operator greps one id across both).
+
+Records land in a bounded in-memory ring buffer (served at `GET /logs` on
+the ServingServer and the UI server) and fan out to pluggable sinks
+(stderr JSON-lines, append-to-file, or anything callable). Every record
+also increments `log_events_total{level}` in a MetricsRegistry, which makes
+"error logs per second" an alertable series like any other counter.
+
+Timestamps come from util/time_source, so a ManualClock makes log tests
+deterministic; sink failures are swallowed (counted on the logger) — an
+observability tier must never take down the path it observes.
+"""
+from __future__ import annotations
+
+import sys
+import threading
+
+from .trace import current_span
+from ..util.time_source import now_s
+
+
+def _dumps(record):
+    """Strict JSON line for a record: non-finite floats (e.g. a logged NaN
+    loss) become null so every emitted line stays machine-parseable."""
+    from ..util.http import dumps_safe
+    return dumps_safe(record, default=str)
+
+LEVELS = ("debug", "info", "warning", "error")
+_RANK = {name: i for i, name in enumerate(LEVELS)}
+
+
+def level_rank(level):
+    """Numeric severity for a level name (unknown names rank as error)."""
+    return _RANK.get(str(level).lower(), _RANK["error"])
+
+
+class LogBuffer:
+    """Bounded most-recent ring of log record dicts."""
+
+    def __init__(self, capacity=2048):
+        self.capacity = max(1, int(capacity))
+        self._items = []
+        self._lock = threading.Lock()
+        self.dropped = 0          # records evicted by the ring bound
+        self.total = 0            # records ever appended
+
+    def append(self, record):
+        with self._lock:
+            self._items.append(record)
+            self.total += 1
+            if len(self._items) > self.capacity:
+                del self._items[:len(self._items) - self.capacity]
+                self.dropped += 1
+
+    def records(self, level=None, n=None, trace_id=None):
+        """Most-recent records, oldest first. `level` is a minimum severity;
+        `trace_id` filters to one request/iteration's records."""
+        with self._lock:
+            out = list(self._items)
+        if level is not None:
+            floor = level_rank(level)
+            out = [r for r in out if level_rank(r["level"]) >= floor]
+        if trace_id is not None:
+            want = int(trace_id)
+            out = [r for r in out if r.get("trace_id") == want]
+        if n is not None:
+            n = int(n)
+            out = out[-n:] if n > 0 else []   # -0 would slice the WHOLE list
+        return out
+
+    def to_dict(self, level=None, n=None, trace_id=None):
+        return {"records": self.records(level=level, n=n, trace_id=trace_id),
+                "count": self.total, "dropped": self.dropped,
+                "capacity": self.capacity}
+
+    def clear(self):
+        with self._lock:
+            self._items = []
+            self.dropped = 0
+
+
+class StderrJsonSink:
+    """One JSON line per record to stderr (or any text stream)."""
+
+    def __init__(self, stream=None):
+        self.stream = stream
+
+    def __call__(self, record):
+        stream = self.stream if self.stream is not None else sys.stderr
+        stream.write(_dumps(record) + "\n")
+
+
+class FileJsonSink:
+    """Append-a-JSON-line-per-record file sink (JSONL, like ui/storage's
+    FileStatsStorage log)."""
+
+    def __init__(self, path):
+        self.path = str(path)
+        self._fh = open(self.path, "a")
+        self._lock = threading.Lock()
+
+    def __call__(self, record):
+        with self._lock:
+            if self._fh.closed:
+                return
+            self._fh.write(_dumps(record) + "\n")
+            self._fh.flush()
+
+    def close(self):
+        with self._lock:
+            self._fh.close()
+
+
+class StructuredLogger:
+    """Producer of structured records: ring buffer + sinks + level counter.
+
+    `logger.info("deploy", version="v2")` appends
+    `{"time", "level", "logger", "message", "trace_id", "span_id",
+      "fields": {"version": "v2"}}` — trace/span ids resolved from the
+    thread-current span at call time.
+    """
+
+    def __init__(self, name="root", buffer=None, sinks=None, registry=None,
+                 level="debug"):
+        self.name = str(name)
+        self.buffer = buffer if buffer is not None else LogBuffer()
+        self.sinks = list(sinks or [])
+        if registry is None:
+            from .registry import get_registry
+            registry = get_registry()
+        self.registry = registry
+        self._counter = registry.counter(
+            "log_events_total", "Structured log records by level")
+        self._floor = level_rank(level)
+        self.sink_errors = 0
+
+    def set_level(self, level):
+        self._floor = level_rank(level)
+
+    def add_sink(self, sink):
+        self.sinks.append(sink)
+        return sink
+
+    def child(self, name):
+        """A logger sharing this one's buffer/sinks/counter under a
+        dotted name (`serving.batcher`)."""
+        c = StructuredLogger.__new__(StructuredLogger)
+        c.name = f"{self.name}.{name}"
+        c.buffer = self.buffer
+        c.sinks = self.sinks           # shared on purpose
+        c.registry = self.registry
+        c._counter = self._counter
+        c._floor = self._floor
+        c.sink_errors = 0
+        return c
+
+    # ---- producing ---------------------------------------------------------
+    def log(self, level, message, **fields):
+        level = str(level).lower()
+        if level_rank(level) < self._floor:
+            return None
+        record = {"time": now_s(), "level": level, "logger": self.name,
+                  "message": str(message)}
+        span = current_span()
+        if span is not None and span.trace_id is not None:
+            record["trace_id"] = span.trace_id
+            record["span_id"] = span.span_id
+        if fields:
+            record["fields"] = fields
+        self._counter.inc(1, level=level)
+        self.buffer.append(record)
+        for sink in self.sinks:
+            try:
+                sink(record)
+            except Exception:
+                self.sink_errors += 1   # a dead sink must not kill the caller
+        return record
+
+    def debug(self, message, **fields):
+        return self.log("debug", message, **fields)
+
+    def info(self, message, **fields):
+        return self.log("info", message, **fields)
+
+    def warning(self, message, **fields):
+        return self.log("warning", message, **fields)
+
+    def error(self, message, **fields):
+        return self.log("error", message, **fields)
+
+
+# ---- process-default logger -------------------------------------------------
+_default_logger = None
+_default_lock = threading.Lock()
+
+
+def get_logger() -> StructuredLogger:
+    """Process-default logger (lazy: instruments register into the default
+    MetricsRegistry on first use, not at import)."""
+    global _default_logger
+    with _default_lock:
+        if _default_logger is None:
+            _default_logger = StructuredLogger(name="root")
+        return _default_logger
+
+
+def set_logger(logger) -> StructuredLogger:
+    global _default_logger
+    with _default_lock:
+        _default_logger = logger
+    return logger

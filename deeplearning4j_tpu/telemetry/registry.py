@@ -26,6 +26,16 @@ def _labelkey(labels):
     return tuple(sorted(labels.items()))
 
 
+def _quantile(sorted_vals, q):
+    """Exact quantile over an already-sorted list, or None when empty (the
+    one implementation behind percentile/percentiles/percentile_merged)."""
+    if not sorted_vals:
+        return None
+    idx = min(len(sorted_vals) - 1,
+              int(round(float(q) * (len(sorted_vals) - 1))))
+    return sorted_vals[idx]
+
+
 class _Instrument:
     kind = "untyped"
 
@@ -106,16 +116,32 @@ class Gauge(_Instrument):
         if self._fn is not None:
             try:
                 return self._fn()
-            except Exception:
+            except Exception as e:
+                self._log_callback_error(e)
                 return None
         with self._lock:
             return self._values.get(_labelkey(labels))
+
+    def _log_callback_error(self, exc):
+        # prefer the owning registry's logger (a ServingServer wires its own
+        # StructuredLogger there, so the error shows on THAT server's /logs);
+        # lazy import: logging builds its counter on this module's registry
+        try:
+            logger = getattr(getattr(self, "_owner", None), "logger", None)
+            if logger is None:
+                from .logging import get_logger
+                logger = get_logger()
+            logger.warning("gauge_callback_error", metric=self.name,
+                           error=f"{type(exc).__name__}: {exc}")
+        except Exception:
+            pass                       # logging must never break a scrape
 
     def series(self):
         if self._fn is not None:
             try:
                 v = self._fn()
-            except Exception:          # a dead callback must not kill scrape
+            except Exception as e:     # a dead callback must not kill scrape
+                self._log_callback_error(e)
                 return []
             if v is None:
                 return []
@@ -201,11 +227,17 @@ class Histogram(_Instrument):
         """Exact percentile over the recent reservoir (sorted OUTSIDE the
         lock), or None when empty."""
         vals = self._reservoir_copy(labels)
-        if not vals:
-            return None
         vals.sort()
-        idx = min(len(vals) - 1, int(round(float(q) * (len(vals) - 1))))
-        return vals[idx]
+        return _quantile(vals, q)
+
+    def percentile_merged(self, q):
+        """Exact percentile over the UNION of every label-set's reservoir —
+        the read an alert rule wants when it names no labels (e.g. consumer
+        wait across all ETL pipelines, which record under pipeline=<name>)."""
+        with self._lock:
+            vals = [v for st in self._states.values() for v in st.reservoir]
+        vals.sort()
+        return _quantile(vals, q)
 
     def percentiles(self, qs=(0.50, 0.95, 0.99), **labels):
         """One reservoir copy + one sort for several quantiles; returns
@@ -214,9 +246,7 @@ class Histogram(_Instrument):
         vals.sort()
         out = {"count": len(vals)}
         for q in qs:
-            key = f"p{int(round(q * 100))}"
-            out[key] = None if not vals else \
-                vals[min(len(vals) - 1, int(round(q * (len(vals) - 1))))]
+            out[f"p{int(round(q * 100))}"] = _quantile(vals, q)
         out["max"] = vals[-1] if vals else None
         return out
 
@@ -236,17 +266,22 @@ class Histogram(_Instrument):
 
 
 class MetricsRegistry:
-    """Get-or-create named instruments; collect them all for exposition."""
+    """Get-or-create named instruments; collect them all for exposition.
+    `logger` (optional, a StructuredLogger) receives instrument-level
+    problems like raising gauge callbacks — a server wires its own logger
+    here so those records show on that server's /logs."""
 
-    def __init__(self):
+    def __init__(self, logger=None):
         self._metrics = {}
         self._lock = threading.Lock()
+        self.logger = logger
 
     def _get_or_create(self, cls, name, help, **kw):
         with self._lock:
             m = self._metrics.get(name)
             if m is None:
                 m = self._metrics[name] = cls(name, help=help, **kw)
+                m._owner = self
             elif not isinstance(m, cls):
                 raise TypeError(
                     f"metric {name!r} already registered as {m.kind}")

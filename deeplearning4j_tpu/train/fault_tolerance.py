@@ -55,12 +55,22 @@ class FaultTolerantTrainer:
     MODEL_FILE = "model.zip"
     SHARDED_DIR = "model_sharded"
 
-    def __init__(self, model_or_factory, checkpoint: CheckpointConfig):
+    def __init__(self, model_or_factory, checkpoint: CheckpointConfig,
+                 health=None):
+        """`health`: a TrainingHealthListener (optimize.listeners) — the
+        trainer attaches it to the model and, when a fatal condition trips
+        (NaN loss/gradients, divergence), writes one final QUARANTINED
+        checkpoint (`halt-<iter>`, kept for forensics but never auto-
+        restored — its params are the corrupted/diverged state) and raises
+        TrainingHalted instead of burning accelerator hours on a dead run.
+        Restarting resumes from the newest periodic `ckpt-*` checkpoint,
+        which predates the blow-up."""
         self.ckpt = checkpoint
         os.makedirs(self.ckpt.directory, exist_ok=True)
         self._factory = (model_or_factory if callable(model_or_factory)
                          else (lambda: model_or_factory))
         self.model = None
+        self.health = health
         self.state = {"epoch": 0, "batch": 0, "iteration": 0, "rng": None}
         self._restored = self._try_restore()
 
@@ -80,13 +90,15 @@ class FaultTolerantTrainer:
                 shutil.rmtree(os.path.join(self.ckpt.directory, name),
                               ignore_errors=True)
 
-    def checkpoint(self):
+    def checkpoint(self, prefix="ckpt"):
         """Write an atomic checkpoint of model + training state. Cost is
         accounted in the telemetry registry (checkpoints_total /
         checkpoint_ms_total) and as a span — checkpoint stalls are a real
-        training-throughput tax worth seeing next to iteration times."""
+        training-throughput tax worth seeing next to iteration times.
+        `prefix` other than "ckpt" (the watchdog's "halt") is invisible to
+        _try_restore/_gc: quarantined, kept, never auto-resumed."""
         it = self.state["iteration"]
-        final = os.path.join(self.ckpt.directory, f"ckpt-{it:09d}")
+        final = os.path.join(self.ckpt.directory, f"{prefix}-{it:09d}")
         if os.path.isdir(final):
             return final  # this iteration is already durably checkpointed
         with get_tracer().span("checkpoint", iteration=it):
@@ -172,9 +184,15 @@ class FaultTolerantTrainer:
     # ------------------------------------------------------------ training
     def fit(self, iterator, epochs=1):
         """Train with checkpoints every `frequency` iterations; on resume,
-        fast-forwards past the batches the dead process already consumed."""
+        fast-forwards past the batches the dead process already consumed.
+        With a health listener attached, a fatal watchdog condition
+        checkpoints once more and raises TrainingHalted."""
         from ..datasets.iterator.base import as_iterator
         it = as_iterator(iterator)
+        listeners = getattr(self.model, "listeners", None)
+        if self.health is not None and listeners is not None \
+                and self.health not in listeners:
+            listeners.append(self.health)
         freq = self.ckpt.frequency
         start_epoch = self.state["epoch"]
         for epoch in range(start_epoch, epochs):
@@ -189,8 +207,20 @@ class FaultTolerantTrainer:
                 b += 1
                 self.state.update(epoch=epoch, batch=b,
                                   iteration=self.state["iteration"] + 1)
+                self._halt_if_unhealthy()
                 if freq and self.state["iteration"] % freq == 0:
                     self.checkpoint()
             self.state.update(epoch=epoch + 1, batch=0)
         self.checkpoint()
         return self.model
+
+    def _halt_if_unhealthy(self):
+        if self.health is None or not self.health.should_halt:
+            return
+        from ..optimize.listeners.health import TrainingHalted
+        # the fatal update is already applied to the params, so this state
+        # is forensics, not a resume point: quarantine it under halt-* and
+        # leave the ckpt-* chain ending at the last pre-blow-up checkpoint
+        path = self.checkpoint(prefix="halt")
+        raise TrainingHalted(self.health.trip_reason,
+                             self.state["iteration"], checkpoint_path=path)

@@ -265,6 +265,78 @@ class MetricsModule(UIModule):
                 json.dumps(self.registry.snapshot()).encode())
 
 
+class HealthModule(UIModule):
+    """Deep `GET /healthz` for the training/UI process: aggregates the
+    HealthMonitor's component probes (ETL pipelines, the trainer via
+    TrainingHealthListener, anything else registered) and answers 503 when
+    any component is unhealthy — the training-side mirror of the
+    ServingServer's deep health endpoint."""
+
+    def __init__(self, monitor=None):
+        if monitor is None:
+            from ..telemetry.health import get_monitor
+            monitor = get_monitor()
+        self.monitor = monitor
+
+    def routes(self):
+        return {("GET", "/healthz"): self._healthz}
+
+    def _healthz(self, query, body):
+        from ..util.http import dumps_safe
+        report = self.monitor.check()
+        status = self.monitor.http_status(report)
+        # dumps_safe + default=str: a trainer probe may carry a NaN
+        # last_loss, and custom probe detail may hold arbitrary objects
+        return (status, "application/json",
+                dumps_safe(report, default=str).encode())
+
+
+class AlertsModule(UIModule):
+    """`GET /alerts`: the rule lifecycle state of an AlertEngine (pass one
+    watching the training registry; defaults to an empty, rule-less engine
+    over the process registry so the endpoint always answers)."""
+
+    def __init__(self, engine=None):
+        if engine is None:
+            from ..telemetry.alerts import AlertEngine
+            engine = AlertEngine(interval_s=0)
+        self.engine = engine
+
+    def routes(self):
+        return {("GET", "/alerts"): self._alerts}
+
+    def _alerts(self, query, body):
+        from ..util.http import dumps_safe
+        return 200, "application/json", dumps_safe(
+            self.engine.state(), default=str).encode()
+
+
+class LogsModule(UIModule):
+    """`GET /logs`: the structured logger's bounded ring buffer
+    (?level=error&n=100&trace_id=N), trace/span-correlated records."""
+
+    def __init__(self, logger=None):
+        if logger is None:
+            from ..telemetry.logging import get_logger
+            logger = get_logger()
+        self.logger = logger
+
+    def routes(self):
+        return {("GET", "/logs"): self._logs}
+
+    def _logs(self, query, body):
+        from ..util.http import dumps_safe
+        try:
+            payload = self.logger.buffer.to_dict(
+                level=query.get("level"), n=int(query.get("n", 256)),
+                trace_id=query.get("trace_id"))
+        except ValueError as e:           # ?n=all / ?trace_id=abc -> 400
+            return (400, "application/json",
+                    dumps_safe({"error": f"bad query: {e}"}).encode())
+        return (200, "application/json",
+                dumps_safe(payload, default=str).encode())
+
+
 class RemoteReceiverModule(UIModule):
     """Accepts POSTed reports from RemoteUIStatsStorageRouter (reference:
     module/remote/RemoteReceiverModule.java)."""
@@ -292,13 +364,17 @@ class UIServer(BackgroundHttpServer):
 
     _instance = None
 
-    def __init__(self, port=9000, modules=None, registry=None):
+    def __init__(self, port=9000, modules=None, registry=None, health=None,
+                 alerts=None, logger=None):
         super().__init__(host="127.0.0.1", port=port)
         self.storage = None
         self.modules = modules or [DefaultModule(), TrainModule(),
                                    HistogramModule(), FlowModule(),
                                    ConvolutionalModule(), TsneModule(),
                                    MetricsModule(registry),
+                                   HealthModule(health),
+                                   AlertsModule(alerts),
+                                   LogsModule(logger),
                                    RemoteReceiverModule()]
         self._routes = {}
         for m in self.modules:

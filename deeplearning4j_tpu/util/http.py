@@ -7,13 +7,39 @@ thread, port-0 resolution, shutdown/close, and JSON response writing.
 from __future__ import annotations
 
 import json
+import math
 import threading
+import urllib.request
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 
 
+def _sanitize_nonfinite(obj):
+    """Deep-copy `obj` with non-finite floats replaced by None."""
+    if isinstance(obj, float):
+        return obj if math.isfinite(obj) else None
+    if isinstance(obj, dict):
+        return {k: _sanitize_nonfinite(v) for k, v in obj.items()}
+    if isinstance(obj, (list, tuple)):
+        return [_sanitize_nonfinite(v) for v in obj]
+    return obj
+
+
+def dumps_safe(obj, default=None) -> str:
+    """json.dumps that never emits bare NaN/Infinity (which JSON.parse and
+    every strict decoder reject): the fast path serializes with
+    allow_nan=False, and only a payload that actually contains a non-finite
+    float pays the sanitizing second pass (non-finite -> null). `default`
+    passes through to json.dumps (log sinks use default=str)."""
+    try:
+        return json.dumps(obj, allow_nan=False, default=default)
+    except ValueError:
+        return json.dumps(_sanitize_nonfinite(obj), allow_nan=False,
+                          default=default)
+
+
 def send_json(handler: BaseHTTPRequestHandler, status: int, obj,
-              headers=None) -> None:
-    payload = json.dumps(obj).encode()
+              headers=None, default=None) -> None:
+    payload = dumps_safe(obj, default=default).encode()
     handler.send_response(status)
     handler.send_header("Content-Type", "application/json")
     handler.send_header("Content-Length", str(len(payload)))
@@ -36,6 +62,25 @@ def send_text(handler: BaseHTTPRequestHandler, status: int, text,
     handler.wfile.write(payload)
 
 
+def post_json(url, obj, timeout=5.0, headers=None):
+    """Client-side JSON POST (webhook sinks, remote routers): returns the
+    decoded JSON response body, or None for an empty body. Uses the same
+    non-finite sanitization as send_json."""
+    body = dumps_safe(obj).encode()
+    hdrs = {"Content-Type": "application/json"}
+    hdrs.update(headers or {})
+    req = urllib.request.Request(url, data=body, headers=hdrs)
+    with urllib.request.urlopen(req, timeout=timeout) as resp:
+        data = resp.read()
+    if not data:
+        return None
+    try:
+        return json.loads(data)
+    except ValueError:
+        # a 2xx ack with a non-JSON body ("ok") is still a success
+        return data.decode(errors="replace")
+
+
 def read_body(handler: BaseHTTPRequestHandler) -> bytes:
     n = int(handler.headers.get("Content-Length", 0))
     return handler.rfile.read(n) if n else b""
@@ -47,8 +92,8 @@ class QuietHandler(BaseHTTPRequestHandler):
     def log_message(self, *a):
         pass
 
-    def send_json(self, status, obj, headers=None):
-        send_json(self, status, obj, headers)
+    def send_json(self, status, obj, headers=None, default=None):
+        send_json(self, status, obj, headers, default=default)
 
     def send_text(self, status, text, content_type="text/plain; charset=utf-8",
                   headers=None):
