@@ -1,0 +1,29 @@
+"""graftlint — AST-based static analysis enforcing this codebase's invariants.
+
+The last several PRs fixed the *same classes* of bug by hand: non-finite
+floats leaking through raw ``json.dumps`` into HTTP responses, clocks read
+outside ``util/time_source`` (so ManualClock tests can't drive them), and
+lock-guarded state touched off-lock. Production stacks stop re-fixing bug
+classes by encoding them as machine-checked invariants — the same
+lint-as-a-test-gate discipline JAX itself and large TF codebases use for
+trace/host-sync hazards. This package is that checker.
+
+Pieces:
+  core.py      Rule SPI, registry, suppression comments, Analyzer
+  rules.py     GL001–GL006 (see RULES.md for the catalog + rationale)
+  baseline.py  committed-baseline support (pre-existing violations don't
+               block; NEW ones fail)
+  cli.py       `python -m deeplearning4j_tpu.analysis` / tools/lint.py
+
+Run:   python tools/lint.py [paths...] [--format=json|text]
+Gate:  tests/test_static_analysis.py runs the whole pass in tier-1.
+"""
+from .baseline import Baseline
+from .core import Analyzer, FileContext, Report, Rule, Violation, all_rules, \
+    get_rule, register
+from . import rules  # noqa: F401  (import for the registration side effect)
+
+__all__ = [
+    "Analyzer", "Baseline", "FileContext", "Report", "Rule", "Violation",
+    "all_rules", "get_rule", "register",
+]

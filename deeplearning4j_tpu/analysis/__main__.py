@@ -1,0 +1,6 @@
+"""`python -m deeplearning4j_tpu.analysis` — the graftlint entry point."""
+import sys
+
+from .cli import main
+
+sys.exit(main())

@@ -1,0 +1,97 @@
+"""Committed-baseline support: pre-existing violations don't block the gate,
+NEW ones fail.
+
+The baseline is a JSON file of annotated entries. Matching is by
+(rule, path, stripped-source-line) — NOT by line number — so edits elsewhere
+in a file never invalidate the baseline; identical lines are matched as a
+multiset (N entries absorb at most N findings). ``--baseline-update``
+rewrites the file from the current findings, preserving the human-written
+``note`` on every entry that still matches.
+
+Every entry SHOULD carry a note saying why the violation is tolerated; the
+repo's committed baseline (tools/lint_baseline.json) is kept note-complete
+and the test gate asserts it stays that way.
+"""
+from __future__ import annotations
+
+import collections
+import json
+import os
+
+VERSION = 1
+
+
+class Baseline:
+    def __init__(self, entries=None):
+        # entry: {"rule", "path", "line", "code", "note"}
+        self.entries = list(entries or [])
+
+    # -- persistence ---------------------------------------------------------
+    @classmethod
+    def load(cls, path):
+        """Load from `path`; a missing file is an empty baseline (so a fresh
+        checkout of a clean repo needs no baseline at all)."""
+        if not os.path.exists(path):
+            return cls()
+        with open(path, encoding="utf-8") as f:
+            data = json.load(f)
+        if data.get("version") != VERSION:
+            raise ValueError(
+                f"unsupported baseline version {data.get('version')!r} "
+                f"in {path} (expected {VERSION})")
+        return cls(data.get("entries", []))
+
+    def save(self, path):
+        data = {"version": VERSION, "entries": self.entries}
+        tmp = path + ".tmp"
+        with open(tmp, "w", encoding="utf-8") as f:
+            json.dump(data, f, indent=1, sort_keys=True)
+            f.write("\n")
+        os.replace(tmp, path)
+
+    # -- matching ------------------------------------------------------------
+    @staticmethod
+    def _key(entry):
+        return (entry["rule"], entry["path"], entry["code"])
+
+    def split(self, violations):
+        """Partition `violations` into (new, baselined)."""
+        budget = collections.Counter(self._key(e) for e in self.entries)
+        new, matched = [], []
+        for v in violations:
+            if budget[v.key] > 0:
+                budget[v.key] -= 1
+                matched.append(v)
+            else:
+                new.append(v)
+        return new, matched
+
+    def stale_entries(self, violations):
+        """Entries no longer matched by any current violation (fixed code
+        whose baseline entry should be dropped on the next --baseline-update)."""
+        seen = collections.Counter(v.key for v in violations)
+        stale = []
+        for e in self.entries:
+            if seen[self._key(e)] > 0:
+                seen[self._key(e)] -= 1
+            else:
+                stale.append(e)
+        return stale
+
+    @classmethod
+    def from_violations(cls, violations, previous=None):
+        """Build a fresh baseline from current findings, carrying over notes
+        from a previous baseline's still-matching entries."""
+        notes = collections.defaultdict(list)
+        if previous is not None:
+            for e in previous.entries:
+                if e.get("note"):
+                    notes[cls._key(e)].append(e["note"])
+        entries = []
+        for v in sorted(violations, key=lambda v: (v.path, v.line, v.rule)):
+            pool = notes.get(v.key)
+            entries.append({
+                "rule": v.rule, "path": v.path, "line": v.line,
+                "code": v.code, "note": pool.pop(0) if pool else "",
+            })
+        return cls(entries)

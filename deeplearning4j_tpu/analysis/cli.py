@@ -1,0 +1,155 @@
+"""graftlint CLI (used by tools/lint.py and `python -m deeplearning4j_tpu.analysis`).
+
+Usage:
+    python tools/lint.py [paths...] [options]
+
+Paths default to the package and tools/ trees. Exit status: 0 = clean (no
+NEW violations, no parse errors), 1 = new violations or unparseable files,
+2 = bad invocation.
+
+Options:
+    --format=text|json   json is machine-readable (pre-commit / CI tooling)
+    --baseline PATH      baseline file (default tools/lint_baseline.json)
+    --baseline-update    rewrite the baseline from current findings (keeps
+                         notes on still-matching entries) and exit 0
+    --no-baseline        ignore the baseline: report every violation as new
+    --rules GL001,GL002  run a subset of rules
+    --list-rules         print the rule catalog and exit
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+from .baseline import Baseline
+from .core import Analyzer, all_rules
+
+# repo root = parents of deeplearning4j_tpu/analysis/cli.py — but only when
+# that actually IS a checkout: for a pip-installed `graftlint` the parents
+# are site-packages, and rooting there would lint the installed copy instead
+# of the user's project, so fall back to the invocation cwd
+_PKG_PARENT = os.path.dirname(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+_REPO_ROOT = _PKG_PARENT if os.path.exists(
+    os.path.join(_PKG_PARENT, "pyproject.toml")) else os.getcwd()
+DEFAULT_PATHS = ("deeplearning4j_tpu", "tools")
+DEFAULT_BASELINE = os.path.join("tools", "lint_baseline.json")
+
+
+def build_parser():
+    p = argparse.ArgumentParser(
+        prog="graftlint",
+        description="AST-based static analysis enforcing this codebase's "
+                    "invariants (clock discipline, strict JSON, lock guards, "
+                    "jit host-sync hazards).")
+    p.add_argument("paths", nargs="*", default=None,
+                   help="files/directories to lint, relative to the CURRENT "
+                        f"directory (default: {' '.join(DEFAULT_PATHS)} "
+                        "under --root)")
+    p.add_argument("--root", default=_REPO_ROOT,
+                   help="root for relative paths + baseline (default: repo)")
+    p.add_argument("--format", choices=("text", "json"), default="text")
+    p.add_argument("--baseline", default=None,
+                   help="baseline file; an explicit relative path resolves "
+                        "against the CURRENT directory "
+                        f"(default: {DEFAULT_BASELINE} under --root)")
+    p.add_argument("--baseline-update", action="store_true",
+                   help="rewrite the baseline from current findings")
+    p.add_argument("--no-baseline", action="store_true",
+                   help="ignore the baseline entirely")
+    p.add_argument("--rules", default=None,
+                   help="comma-separated subset of rule ids to run")
+    p.add_argument("--list-rules", action="store_true")
+    return p
+
+
+def select_rules(spec):
+    rules = all_rules()
+    if spec is None:
+        return rules
+    wanted = {r.strip().upper() for r in spec.split(",") if r.strip()}
+    known = {r.id for r in rules}
+    unknown = wanted - known
+    if unknown:
+        raise SystemExit(f"graftlint: unknown rule(s): {', '.join(sorted(unknown))}")
+    return [r for r in rules if r.id in wanted]
+
+
+def main(argv=None) -> int:
+    args = build_parser().parse_args(argv)
+    if args.list_rules:
+        for r in all_rules():
+            print(f"{r.id}  {r.name}")
+            print(f"       {r.rationale}")
+        return 0
+
+    root = os.path.abspath(args.root)
+    analyzer = Analyzer(rules=select_rules(args.rules), root=root)
+    # explicit path arguments are resolved like any CLI resolves them —
+    # against the invoker's cwd; only the defaults are root-relative
+    paths = ([os.path.abspath(p) for p in args.paths] if args.paths
+             else list(DEFAULT_PATHS))
+    report = analyzer.analyze_paths(paths)
+
+    baseline_path = (os.path.join(root, DEFAULT_BASELINE)
+                     if args.baseline is None
+                     else os.path.abspath(args.baseline))
+
+    if args.baseline_update:
+        if report.errors:
+            # refuse: an unparseable file reports zero violations, so its
+            # baseline entries (and their notes) would be silently re-derived
+            # to nothing and resurface as NEW debt once the file parses again
+            for err in report.errors:
+                print(f"PARSE ERROR: {err}")
+            print("graftlint: baseline NOT updated (fix the errors first)")
+            return 1
+        previous = Baseline.load(baseline_path)
+        # a SCOPED update (path or rule subset) re-derives only what this run
+        # actually analyzed; entries outside the analyzed files / active
+        # rules are preserved verbatim (notes included), never dropped
+        analyzed = set(report.rel_files)
+        active = {r.id for r in analyzer.rules}
+        preserved = [e for e in previous.entries
+                     if e["path"] not in analyzed or e["rule"] not in active]
+        updated = Baseline.from_violations(report.violations,
+                                           previous=previous)
+        merged = sorted(preserved + updated.entries,
+                        key=lambda e: (e["path"], e["line"], e["rule"]))
+        Baseline(merged).save(baseline_path)
+        print(f"graftlint: baseline updated: {len(merged)} "
+              f"entr{'y' if len(merged) == 1 else 'ies'} "
+              f"({len(updated.entries)} re-derived, {len(preserved)} "
+              f"out-of-scope preserved) "
+              f"-> {os.path.relpath(baseline_path, root)}")
+        return 0
+
+    if args.no_baseline:
+        new, matched = report.violations, []
+    else:
+        new, matched = Baseline.load(baseline_path).split(report.violations)
+
+    if args.format == "json":
+        print(json.dumps({
+            "new": [v.to_dict() for v in new],
+            "baselined": len(matched),
+            "files_checked": report.files_checked,
+            "errors": report.errors,
+            "ok": not new and not report.errors,
+        }, indent=1))
+    else:
+        for v in new:
+            print(v)
+        for err in report.errors:
+            print(f"PARSE ERROR: {err}")
+        print(f"graftlint: {report.files_checked} files, "
+              f"{len(new)} new violation(s), {len(matched)} baselined"
+              + (f", {len(report.errors)} parse error(s)"
+                 if report.errors else ""))
+    return 1 if (new or report.errors) else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

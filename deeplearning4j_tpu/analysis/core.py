@@ -1,0 +1,295 @@
+"""graftlint core: Rule SPI, registry, suppression comments, Analyzer.
+
+Design notes
+------------
+* Rules are pure functions of one parsed file (``FileContext``): source text,
+  AST, comment map, and light import resolution. Cross-file analysis is out of
+  scope — every invariant the codebase needs so far is intra-file.
+* Suppression is comment-driven, pylint-style but with a project-specific
+  marker so it can never collide with other linters:
+      x = time.time()          # graftlint: disable=GL001  <why it's OK>
+      # graftlint: disable=GL003           (alone on a line: applies to the
+      #                                      NEXT line — for long statements)
+      # graftlint: disable-file=GL004      (anywhere: whole file)
+  A bare ``disable`` with no ``=RULES`` silences every rule for that line.
+* Pre-existing violations live in a committed baseline (baseline.py) so the
+  gate only fails on NEW findings; suppressions are for violations a human has
+  judged acceptable *forever* (and must carry a rationale in the comment).
+"""
+from __future__ import annotations
+
+import ast
+import dataclasses
+import io
+import os
+import re
+import tokenize
+
+_SUPPRESS_RE = re.compile(
+    r"graftlint:\s*(?P<kind>disable-file|disable)"
+    r"(?:\s*=\s*(?P<rules>[A-Za-z0-9_]+(?:\s*,\s*[A-Za-z0-9_]+)*))?")
+
+_ALL = object()  # sentinel: "every rule" in a suppression set (NOT None —
+                 # dict.get misses must stay distinguishable from it)
+
+
+@dataclasses.dataclass
+class Violation:
+    """One finding: rule id, location, message, and the stripped source line
+    (`code`) that serves as the line-drift-tolerant baseline fingerprint."""
+
+    rule: str
+    path: str       # posix path relative to the analysis root
+    line: int
+    col: int
+    message: str
+    code: str
+
+    @property
+    def key(self):
+        """Baseline identity: stable across unrelated edits above the line."""
+        return (self.rule, self.path, self.code)
+
+    def to_dict(self):
+        return dataclasses.asdict(self)
+
+    def __str__(self):
+        return f"{self.path}:{self.line}:{self.col}: {self.rule}: {self.message}"
+
+
+def import_aliases(tree_or_ctx):
+    """name-in-scope -> dotted origin ("np" -> "numpy", "jit" -> "jax.jit",
+    "Thread" -> "threading.Thread"). Relative imports keep their dots."""
+    aliases = {}
+    nodes = tree_or_ctx.nodes if isinstance(tree_or_ctx, FileContext) \
+        else ast.walk(tree_or_ctx)
+    for node in nodes:
+        if isinstance(node, ast.Import):
+            for a in node.names:
+                aliases[a.asname or a.name.split(".")[0]] = \
+                    a.name if a.asname else a.name.split(".")[0]
+        elif isinstance(node, ast.ImportFrom):
+            mod = ("." * node.level) + (node.module or "")
+            for a in node.names:
+                if a.name == "*":
+                    continue
+                aliases[a.asname or a.name] = f"{mod}.{a.name}" if mod else a.name
+    return aliases
+
+
+class FileContext:
+    """Everything a rule may look at for one file."""
+
+    def __init__(self, source, rel_path, filename=None):
+        self.source = source
+        self.rel_path = rel_path.replace(os.sep, "/")
+        self.lines = source.splitlines()
+        self.tree = ast.parse(source, filename=filename or rel_path)
+        self._parents = None
+        self._nodes = None
+        self._aliases = None
+        self._line_disables = {}   # lineno -> set of rule ids, or _ALL
+        self._file_disables = set()
+        self._file_disables_all = False
+        self._scan_comments()
+
+    # -- comments ------------------------------------------------------------
+    def _scan_comments(self):
+        """Collect suppression comments via tokenize (never fooled by a
+        'graftlint:' inside a string literal); falls back to a line scan on
+        tokenizer errors so a weird-but-parseable file still lints."""
+        if "graftlint" not in self.source:
+            return      # fast path: no marker anywhere, skip tokenizing
+        comments = []
+        try:
+            for tok in tokenize.generate_tokens(io.StringIO(self.source).readline):
+                if tok.type == tokenize.COMMENT:
+                    comments.append((tok.start[0], tok.start[1], tok.string))
+        except (tokenize.TokenError, IndentationError):
+            for i, text in enumerate(self.lines, 1):
+                if "#" in text:
+                    col = text.index("#")
+                    comments.append((i, col, text[col:]))
+        for lineno, col, text in comments:
+            m = _SUPPRESS_RE.search(text)
+            if not m:
+                continue
+            rules = m.group("rules")
+            rule_set = (_ALL if rules is None
+                        else {r.strip() for r in rules.split(",")})
+            if m.group("kind") == "disable-file":
+                if rule_set is _ALL:
+                    self._file_disables_all = True
+                else:
+                    self._file_disables |= rule_set
+            else:
+                # a comment alone on its line suppresses the NEXT line
+                target = lineno
+                if self.lines[lineno - 1][:col].strip() == "":
+                    target = lineno + 1
+                prev = self._line_disables.get(target)
+                if prev is _ALL or rule_set is _ALL:
+                    self._line_disables[target] = _ALL
+                else:
+                    self._line_disables[target] = (prev or set()) | rule_set
+
+    def suppressed(self, rule_id, line) -> bool:
+        if self._file_disables_all or rule_id in self._file_disables:
+            return True
+        rules = self._line_disables.get(line)
+        return rules is _ALL or (rules is not None and rule_id in rules)
+
+    # -- helpers for rules ---------------------------------------------------
+    def line_text(self, lineno) -> str:
+        if 1 <= lineno <= len(self.lines):
+            return self.lines[lineno - 1]
+        return ""
+
+    @property
+    def nodes(self):
+        """Flat list of every AST node, cached — six rules over 150+ files
+        must not each re-walk the whole tree."""
+        if self._nodes is None:
+            self._nodes = list(ast.walk(self.tree))
+        return self._nodes
+
+    @property
+    def aliases(self):
+        """Cached import_aliases(self.tree)."""
+        if self._aliases is None:
+            self._aliases = import_aliases(self)
+        return self._aliases
+
+    @property
+    def parents(self):
+        """node -> parent map over the whole tree (built once on demand)."""
+        if self._parents is None:
+            self._parents = {}
+            for parent in self.nodes:
+                for child in ast.iter_child_nodes(parent):
+                    self._parents[child] = parent
+        return self._parents
+
+    def ancestors(self, node):
+        """Yield node's ancestors, innermost first."""
+        p = self.parents.get(node)
+        while p is not None:
+            yield p
+            p = self.parents.get(p)
+
+
+class Rule:
+    """SPI: subclass, set `id`/`name`/`rationale`, implement `check`, and
+    decorate with @register. `check` yields/returns Violations; suppression
+    and baseline filtering happen in the Analyzer, not in rules."""
+
+    id = "GL000"
+    name = "abstract-rule"
+    rationale = ""
+
+    def check(self, ctx: FileContext):
+        raise NotImplementedError
+
+    def violation(self, ctx, node, message) -> Violation:
+        line = getattr(node, "lineno", 0) if not isinstance(node, int) else node
+        col = getattr(node, "col_offset", 0) if not isinstance(node, int) else 0
+        return Violation(rule=self.id, path=ctx.rel_path, line=line, col=col,
+                         message=message, code=ctx.line_text(line).strip())
+
+
+_REGISTRY: dict[str, type] = {}
+
+
+def register(cls):
+    """Class decorator adding a Rule subclass to the global registry."""
+    if cls.id in _REGISTRY and _REGISTRY[cls.id] is not cls:
+        raise ValueError(f"duplicate rule id {cls.id}")
+    _REGISTRY[cls.id] = cls
+    return cls
+
+
+def all_rules():
+    """Fresh instances of every registered rule, ordered by id."""
+    return [_REGISTRY[rid]() for rid in sorted(_REGISTRY)]
+
+
+def get_rule(rule_id) -> Rule:
+    return _REGISTRY[rule_id]()
+
+
+@dataclasses.dataclass
+class Report:
+    violations: list      # suppression-filtered, sorted
+    errors: list          # unparseable files / missing paths
+    files_checked: int
+    rel_files: list = dataclasses.field(default_factory=list)
+    # ^ root-relative paths analyzed — a scoped --baseline-update uses this
+    # to know which baseline entries were re-derived vs out of scope
+
+
+_SKIP_DIRS = {"__pycache__", ".git", ".hg", "build", "dist", ".eggs",
+              "node_modules"}
+
+
+class Analyzer:
+    """Runs a rule set over files/trees of Python sources."""
+
+    def __init__(self, rules=None, root=None):
+        self.rules = list(rules) if rules is not None else all_rules()
+        self.root = os.path.abspath(root or os.getcwd())
+
+    def analyze_source(self, source, rel_path):
+        """Lint one in-memory source string; returns (violations, error)."""
+        try:
+            ctx = FileContext(source, rel_path)
+        except (SyntaxError, ValueError) as e:
+            return [], f"{rel_path}: {type(e).__name__}: {e}"
+        out = []
+        for rule in self.rules:
+            for v in rule.check(ctx):
+                if not ctx.suppressed(v.rule, v.line):
+                    out.append(v)
+        out.sort(key=lambda v: (v.path, v.line, v.col, v.rule))
+        return out, None
+
+    def analyze_file(self, path):
+        rel = os.path.relpath(os.path.abspath(path), self.root)
+        try:
+            with open(path, encoding="utf-8") as f:
+                source = f.read()
+        except (OSError, UnicodeDecodeError) as e:
+            return [], f"{rel}: {type(e).__name__}: {e}"
+        return self.analyze_source(source, rel)
+
+    def iter_python_files(self, paths):
+        for p in paths:
+            p = os.path.join(self.root, p) if not os.path.isabs(p) else p
+            if os.path.isfile(p):
+                yield p
+            else:
+                for dirpath, dirnames, filenames in os.walk(p):
+                    dirnames[:] = sorted(d for d in dirnames
+                                         if d not in _SKIP_DIRS)
+                    for fn in sorted(filenames):
+                        if fn.endswith(".py"):
+                            yield os.path.join(dirpath, fn)
+
+    def analyze_paths(self, paths) -> Report:
+        violations, errors, n = [], [], 0
+        for p in paths:
+            full = p if os.path.isabs(p) else os.path.join(self.root, p)
+            if not os.path.exists(full):
+                # a typoed path in CI must fail loudly, not lint 0 files green
+                errors.append(f"{p}: path does not exist")
+        rel_files = []
+        for path in self.iter_python_files(paths):
+            n += 1
+            rel_files.append(os.path.relpath(os.path.abspath(path), self.root)
+                             .replace(os.sep, "/"))
+            vs, err = self.analyze_file(path)
+            violations.extend(vs)
+            if err is not None:
+                errors.append(err)
+        violations.sort(key=lambda v: (v.path, v.line, v.col, v.rule))
+        return Report(violations=violations, errors=errors, files_checked=n,
+                      rel_files=rel_files)

@@ -1,0 +1,537 @@
+"""GL001–GL006: the rule catalog (see RULES.md for the bug-history rationale).
+
+Each rule is intra-file AST analysis with light import resolution: aliases
+from ``import x as y`` / ``from m import n as y`` are resolved so
+``np.asarray`` and ``numpy.asarray`` (or ``from jax import jit``) look the
+same to a rule. Resolution is intentionally shallow — a linter trades
+soundness for zero-setup speed; anything it can't prove, it stays quiet on.
+"""
+from __future__ import annotations
+
+import ast
+import re
+
+from .core import Rule, import_aliases, register  # noqa: F401 (re-export)
+
+
+# ---------------------------------------------------------------------------
+# shared helpers
+# ---------------------------------------------------------------------------
+
+
+def qualname(node, aliases):
+    """Resolve a Name/Attribute chain to a dotted origin, or None if the base
+    name isn't an import-bound alias (i.e. probably a local variable)."""
+    parts = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if not isinstance(node, ast.Name):
+        return None
+    base = aliases.get(node.id)
+    if base is None:
+        return None
+    return ".".join([base] + parts[::-1])
+
+
+def call_qual(node, aliases):
+    """qualname of a Call's callee (None for non-calls/unresolvable)."""
+    if not isinstance(node, ast.Call):
+        return None
+    return qualname(node.func, aliases)
+
+
+def enclosing_function(ctx, node):
+    """Innermost FunctionDef/AsyncFunctionDef containing `node`, or None."""
+    for anc in ctx.ancestors(node):
+        if isinstance(anc, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            return anc
+    return None
+
+
+def is_self_attr(node, attr=None):
+    return (isinstance(node, ast.Attribute)
+            and isinstance(node.value, ast.Name) and node.value.id == "self"
+            and (attr is None or node.attr == attr))
+
+
+# ---------------------------------------------------------------------------
+# GL001 — raw-clock
+# ---------------------------------------------------------------------------
+
+@register
+class RawClockRule(Rule):
+    """time.time()/time.monotonic() outside util/time_source."""
+
+    id = "GL001"
+    name = "raw-clock"
+    rationale = (
+        "Deadlines/timestamps read straight from the `time` module can't be "
+        "driven by ManualClock, so every timeout test sleeps real wall time "
+        "(or flakes). Route wall time through util.time_source.now_s()/"
+        "now_ms() and durations/deadlines through monotonic_s().")
+
+    ALLOW = ("util/time_source.py",)
+    _CLOCKS = {"time.time": "now_s()/now_ms()",
+               "time.monotonic": "monotonic_s()"}
+
+    def check(self, ctx):
+        if ctx.rel_path.endswith(self.ALLOW):
+            return
+        aliases = ctx.aliases
+        for node in ctx.nodes:
+            qual = call_qual(node, aliases)
+            if qual in self._CLOCKS:
+                yield self.violation(
+                    ctx, node,
+                    f"{qual}() read outside util/time_source; use "
+                    f"util.time_source.{self._CLOCKS[qual]} so ManualClock "
+                    f"tests can drive this clock")
+
+
+# ---------------------------------------------------------------------------
+# GL002 — unsafe-json
+# ---------------------------------------------------------------------------
+
+@register
+class UnsafeJsonRule(Rule):
+    """json.dumps on HTTP-response/payload paths instead of dumps_safe."""
+
+    id = "GL002"
+    name = "unsafe-json"
+    rationale = (
+        "Raw json.dumps emits bare NaN/Infinity, which JSON.parse and every "
+        "strict decoder reject — a single non-finite float 500s or corrupts "
+        "an HTTP response. util.http.dumps_safe serializes strict JSON "
+        "(non-finite -> null, numpy scalars via default=).")
+
+    # the one module allowed to call json.dumps on a payload path: the strict
+    # serializer itself (dumps_safe's fast path IS json.dumps)
+    ALLOW = ("util/http.py",)
+    # modules whose whole job is building payloads that go over HTTP (stats
+    # reports are POSTed to /remoteReceive and served back by UI endpoints):
+    # every json.dumps there is payload serialization
+    PAYLOAD_MODULES = ("ui/stats.py",)
+    # callees whose arguments are HTTP bodies/responses
+    _HTTP_SINKS = {"urllib.request.Request", "Request", "send_json",
+                   "post_json"}
+
+    def check(self, ctx):
+        if ctx.rel_path.endswith(self.ALLOW):
+            return
+        aliases = ctx.aliases
+        dumps_calls = [n for n in ctx.nodes
+                       if call_qual(n, aliases) == "json.dumps"]
+        if not dumps_calls:
+            return
+        if ctx.rel_path.endswith(self.PAYLOAD_MODULES):
+            for call in dumps_calls:
+                yield self._flag(ctx, call, "HTTP payload module")
+            return
+        handler_funcs = self._response_tuple_functions(ctx)
+        flagged = set()
+        for call in dumps_calls:
+            fn = enclosing_function(ctx, call)
+            if fn is not None and fn in handler_funcs:
+                flagged.add(call)
+                yield self._flag(ctx, call, "route handler response")
+        for call, why in self._http_sink_flows(ctx, aliases, dumps_calls):
+            if call not in flagged:
+                flagged.add(call)
+                yield self._flag(ctx, call, why)
+
+    def _flag(self, ctx, call, why):
+        return self.violation(
+            ctx, call,
+            f"json.dumps on an HTTP path ({why}); use util.http.dumps_safe "
+            f"(strict JSON: non-finite floats -> null)")
+
+    @staticmethod
+    def _response_tuple_functions(ctx):
+        """Functions returning the (status, content_type, body) route-handler
+        tuple — identified by a content-type string constant in the tuple."""
+        out = set()
+        for node in ctx.nodes:
+            if not (isinstance(node, ast.Return)
+                    and isinstance(node.value, ast.Tuple)):
+                continue
+            for elt in node.value.elts:
+                if (isinstance(elt, ast.Constant) and isinstance(elt.value, str)
+                        and elt.value.startswith(("application/json", "text/"))):
+                    fn = enclosing_function(ctx, node)
+                    if fn is not None:
+                        out.add(fn)
+                    break
+        return out
+
+    def _http_sink_flows(self, ctx, aliases, dumps_calls):
+        """(dumps_call, reason) pairs where the dumps result reaches an HTTP
+        sink — inline, or through one simple same-function assignment."""
+        dumps_set = set(dumps_calls)
+        # name -> dumps node, for `body = json.dumps(d).encode()` idioms,
+        # scoped per enclosing function to avoid cross-function aliasing
+        tainted = {}
+        for node in ctx.nodes:
+            if isinstance(node, ast.Assign) and len(node.targets) == 1 \
+                    and isinstance(node.targets[0], ast.Name):
+                for sub in ast.walk(node.value):
+                    if sub in dumps_set:
+                        fn = enclosing_function(ctx, node)
+                        tainted[(fn, node.targets[0].id)] = sub
+                        break
+        for node in ctx.nodes:
+            if not isinstance(node, ast.Call):
+                continue
+            qual = qualname(node.func, aliases)
+            name = node.func.attr if isinstance(node.func, ast.Attribute) \
+                else (node.func.id if isinstance(node.func, ast.Name) else None)
+            is_sink = (qual in self._HTTP_SINKS or name in self._HTTP_SINKS
+                       or (name == "write" and isinstance(node.func, ast.Attribute)
+                           and isinstance(node.func.value, ast.Attribute)
+                           and node.func.value.attr == "wfile"))
+            if not is_sink:
+                continue
+            fn = enclosing_function(ctx, node)
+            for arg in list(node.args) + [kw.value for kw in node.keywords]:
+                for sub in ast.walk(arg):
+                    if sub in dumps_set:
+                        yield sub, "flows into an HTTP request/response"
+                    elif isinstance(sub, ast.Name) \
+                            and (fn, sub.id) in tainted:
+                        yield tainted[(fn, sub.id)], \
+                            f"'{sub.id}' flows into an HTTP request/response"
+
+
+# ---------------------------------------------------------------------------
+# GL003 — lock-guard
+# ---------------------------------------------------------------------------
+
+_GUARDED_RE = re.compile(r"#\s*guarded by:\s*self\.([A-Za-z_]\w*)")
+
+
+@register
+class LockGuardRule(Rule):
+    """Attributes annotated `# guarded by: self._lock` touched off-lock."""
+
+    id = "GL003"
+    name = "lock-guard"
+    rationale = (
+        "Shared mutable state documented as lock-guarded but read/written "
+        "outside a `with self._lock:` block is a data race (the served-"
+        "counter lost-update bug). The annotation makes the invariant "
+        "machine-checked: declare it once where the attribute is "
+        "initialized, and every off-lock access in the class is flagged. "
+        "__init__/__del__ are exempt (no concurrent callers exist yet/still).")
+
+    EXEMPT_METHODS = {"__init__", "__del__"}
+
+    def check(self, ctx):
+        annotations = [(i, m.group(1))
+                       for i, line in enumerate(ctx.lines, 1)
+                       for m in [_GUARDED_RE.search(line)] if m]
+        if not annotations:
+            return
+        for cls in ast.walk(ctx.tree):
+            if not isinstance(cls, ast.ClassDef):
+                continue
+            end = getattr(cls, "end_lineno", cls.lineno)
+            guarded = {}   # attr -> (lock_attr, decl_line)
+            for lineno, lock in annotations:
+                if not (cls.lineno <= lineno <= end):
+                    continue
+                attr = self._annotated_attr(cls, lineno)
+                if attr is not None:
+                    guarded[attr] = (lock, lineno)
+            if not guarded:
+                continue
+            for meth in cls.body:
+                if not isinstance(meth, (ast.FunctionDef,
+                                         ast.AsyncFunctionDef)):
+                    continue
+                if meth.name in self.EXEMPT_METHODS:
+                    continue
+                yield from self._check_method(ctx, meth, guarded)
+
+    @staticmethod
+    def _annotated_attr(cls, lineno):
+        """self.<attr> assigned on the annotated line (the declaration)."""
+        for node in ast.walk(cls):
+            if isinstance(node, (ast.Assign, ast.AnnAssign, ast.AugAssign)) \
+                    and node.lineno == lineno:
+                targets = node.targets if isinstance(node, ast.Assign) \
+                    else [node.target]
+                for t in targets:
+                    if is_self_attr(t):
+                        return t.attr
+        return None
+
+    def _check_method(self, ctx, meth, guarded):
+        for node in ast.walk(meth):
+            if not is_self_attr(node) or node.attr not in guarded:
+                continue
+            lock, decl_line = guarded[node.attr]
+            if node.lineno == decl_line:
+                continue
+            if self._under_lock(ctx, node, lock, stop_at=meth):
+                continue
+            yield self.violation(
+                ctx, node,
+                f"self.{node.attr} is guarded by self.{lock} but accessed "
+                f"outside a `with self.{lock}:` block")
+
+    @staticmethod
+    def _under_lock(ctx, node, lock, stop_at):
+        for anc in ctx.ancestors(node):
+            if isinstance(anc, ast.With):
+                for item in anc.items:
+                    if is_self_attr(item.context_expr, lock):
+                        return True
+            if anc is stop_at:
+                return False
+        return False
+
+
+# ---------------------------------------------------------------------------
+# GL004 — jit-host-sync
+# ---------------------------------------------------------------------------
+
+@register
+class JitHostSyncRule(Rule):
+    """Host round-trips / trace hazards inside jit-traced functions."""
+
+    id = "GL004"
+    name = "jit-host-sync"
+    rationale = (
+        ".item()/.tolist()/np.asarray/float()/int()/block_until_ready inside "
+        "a jit-traced function either fails at trace time (concretization "
+        "error) or silently forces a device->host sync per call, serializing "
+        "the dispatch queue — the classic JAX/TF trace-hazard class that "
+        "large codebases gate with lint.")
+
+    _SYNC_ATTRS = {"item", "tolist", "block_until_ready"}
+    _SYNC_QUALS = {"numpy.asarray", "numpy.array", "jax.device_get"}
+
+    def check(self, ctx):
+        aliases = ctx.aliases
+        seen = set()
+        for fn in self._traced_functions(ctx, aliases):
+            for node in ast.walk(fn):
+                if not isinstance(node, ast.Call) or id(node) in seen:
+                    continue
+                v = self._hazard(ctx, node, aliases, fn)
+                if v is not None:
+                    seen.add(id(node))
+                    yield v
+
+    def _hazard(self, ctx, node, aliases, fn):
+        if isinstance(node.func, ast.Attribute) \
+                and node.func.attr in self._SYNC_ATTRS:
+            return self.violation(
+                ctx, node,
+                f".{node.func.attr}() inside jit-traced `{fn.name}` forces a "
+                f"host sync or fails at trace time")
+        qual = call_qual(node, aliases)
+        if qual in self._SYNC_QUALS:
+            return self.violation(
+                ctx, node,
+                f"{qual}() inside jit-traced `{fn.name}` materializes the "
+                f"array on host (trace hazard)")
+        if isinstance(node.func, ast.Name) and node.func.id in ("float", "int") \
+                and node.args and not all(isinstance(a, ast.Constant)
+                                          for a in node.args):
+            return self.violation(
+                ctx, node,
+                f"{node.func.id}() on a traced value inside `{fn.name}` "
+                f"concretizes at trace time (TracerConversionError) or "
+                f"host-syncs; use jnp casts or hoist out of jit")
+        return None
+
+    @classmethod
+    def is_jit_expr(cls, node, aliases):
+        """`jax.jit`, `jit` (imported from jax), or partial(jax.jit, ...)."""
+        if qualname(node, aliases) == "jax.jit":
+            return True
+        if isinstance(node, ast.Call):
+            q = qualname(node.func, aliases)
+            if q == "jax.jit":
+                return True
+            if q in ("functools.partial", "partial") and node.args \
+                    and qualname(node.args[0], aliases) == "jax.jit":
+                return True
+        return False
+
+    def _traced_functions(self, ctx, aliases):
+        """FunctionDefs traced by jit: decorated with jax.jit/partial(jax.jit)
+        or passed by name to a jax.jit(...) call anywhere in the file."""
+        wrapped_names = set()
+        for node in ctx.nodes:
+            if isinstance(node, ast.Call) \
+                    and qualname(node.func, aliases) == "jax.jit" \
+                    and node.args and isinstance(node.args[0], ast.Name):
+                wrapped_names.add(node.args[0].id)
+        for node in ctx.nodes:
+            if not isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                continue
+            if node.name in wrapped_names \
+                    or any(self.is_jit_expr(d, aliases)
+                           for d in node.decorator_list):
+                yield node
+
+
+# ---------------------------------------------------------------------------
+# GL005 — thread-hygiene
+# ---------------------------------------------------------------------------
+
+@register
+class ThreadHygieneRule(Rule):
+    """Threads that outlive their owner; exceptions swallowed in workers."""
+
+    id = "GL005"
+    name = "thread-hygiene"
+    rationale = (
+        "A non-daemon thread that nothing joins keeps the interpreter alive "
+        "after main() exits (hung test runs, zombie workers); a bare "
+        "`except: pass` in a worker loop turns crashes into silent data "
+        "loss. Either mark threads daemon= explicitly or join them from a "
+        "close()/stop()/drain() path; worker loops must record or surface "
+        "errors.")
+
+    def check(self, ctx):
+        aliases = ctx.aliases
+        joined = self._joined_or_daemonized(ctx)
+        for node in ctx.nodes:
+            if isinstance(node, ast.Call) \
+                    and qualname(node.func, aliases) == "threading.Thread" \
+                    and not any(kw.arg == "daemon" for kw in node.keywords):
+                target = self._assign_target(ctx, node)
+                if target is None or target not in joined:
+                    yield self.violation(
+                        ctx, node,
+                        "threading.Thread without daemon= and never joined: "
+                        "pass daemon= explicitly, or join it from a "
+                        "close()/stop()/drain() method")
+            if isinstance(node, ast.ExceptHandler) \
+                    and self._swallows_everything(node, aliases) \
+                    and len(node.body) == 1 \
+                    and isinstance(node.body[0], ast.Pass) \
+                    and self._in_loop(ctx, node):
+                yield self.violation(
+                    ctx, node,
+                    "`except: pass` inside a worker loop swallows every "
+                    "error silently; record it (counter/log) or re-raise")
+
+    @staticmethod
+    def _swallows_everything(handler, aliases):
+        if handler.type is None:
+            return True
+        qual = qualname(handler.type, aliases)
+        name = handler.type.id if isinstance(handler.type, ast.Name) else None
+        return name in ("Exception", "BaseException") \
+            or qual in ("Exception", "BaseException")
+
+    @staticmethod
+    def _in_loop(ctx, node):
+        for anc in ctx.ancestors(node):
+            if isinstance(anc, (ast.While, ast.For)):
+                return True
+            if isinstance(anc, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                return False
+        return False
+
+    def _assign_target(self, ctx, call):
+        """'self.<attr>' / bare name the Thread is stored into, or None."""
+        for anc in ctx.ancestors(call):
+            if isinstance(anc, ast.Assign):
+                t = anc.targets[0]
+                if is_self_attr(t):
+                    return f"self.{t.attr}"
+                if isinstance(t, ast.Name):
+                    return t.id
+                return None
+            if isinstance(anc, ast.stmt):
+                return None
+        return None
+
+    @staticmethod
+    def _joined_or_daemonized(ctx):
+        """Targets with `<target>.join(...)` called or `.daemon = True`
+        assigned anywhere in the file."""
+        out = set()
+
+        def target_of(node):
+            if is_self_attr(node):
+                return f"self.{node.attr}"
+            if isinstance(node, ast.Name):
+                return node.id
+            return None
+
+        for node in ctx.nodes:
+            if isinstance(node, ast.Call) \
+                    and isinstance(node.func, ast.Attribute) \
+                    and node.func.attr == "join":
+                t = target_of(node.func.value)
+                if t:
+                    out.add(t)
+            if isinstance(node, ast.Assign) \
+                    and isinstance(node.targets[0], ast.Attribute) \
+                    and node.targets[0].attr == "daemon":
+                t = target_of(node.targets[0].value)
+                if t:
+                    out.add(t)
+        return out
+
+
+# ---------------------------------------------------------------------------
+# GL006 — per-call-jit
+# ---------------------------------------------------------------------------
+
+@register
+class PerCallJitRule(Rule):
+    """jax.jit(...) built inside a loop without a cached handle."""
+
+    id = "GL006"
+    name = "per-call-jit"
+    rationale = (
+        "Every jax.jit(...) call creates a FRESH wrapper with its own "
+        "compilation cache — invoked per loop iteration or per request it "
+        "recompiles every time (seconds per call on TPU). Hoist the jit "
+        "out of the loop or store the wrapper in a keyed cache "
+        "(`self._jits[key] = jax.jit(fn)` is recognized as the cache idiom).")
+
+    def check(self, ctx):
+        aliases = ctx.aliases
+        for node in ctx.nodes:
+            if not (isinstance(node, ast.Call)
+                    and qualname(node.func, aliases) == "jax.jit"):
+                continue
+            if self._in_loop_directly(ctx, node) \
+                    and not self._cached(ctx, node):
+                yield self.violation(
+                    ctx, node,
+                    "jax.jit(...) constructed inside a loop recompiles on "
+                    "every iteration; hoist it out or store the wrapper in "
+                    "a keyed cache")
+
+    @staticmethod
+    def _in_loop_directly(ctx, node):
+        """Inside a For/While of the SAME function body (a def boundary stops
+        the search: code in a nested function doesn't run per iteration of
+        the loop that merely defines it)."""
+        for anc in ctx.ancestors(node):
+            if isinstance(anc, (ast.While, ast.For)):
+                return True
+            if isinstance(anc, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                ast.Lambda)):
+                return False
+        return False
+
+    @staticmethod
+    def _cached(ctx, node):
+        """`cache[key] = jax.jit(...)` (possibly inside a tuple) is the
+        accepted memoization idiom."""
+        for anc in ctx.ancestors(node):
+            if isinstance(anc, ast.Assign):
+                return any(isinstance(t, ast.Subscript) for t in anc.targets)
+            if isinstance(anc, ast.stmt):
+                return False
+        return False

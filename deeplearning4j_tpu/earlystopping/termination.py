@@ -9,7 +9,8 @@ score and wall-clock, checked every iteration.
 from __future__ import annotations
 
 import math
-import time
+
+from ..util.time_source import monotonic_s
 
 
 class EpochTerminationCondition:
@@ -79,17 +80,20 @@ class ScoreImprovementEpochTerminationCondition(EpochTerminationCondition):
 
 
 class MaxTimeIterationTerminationCondition(IterationTerminationCondition):
+    """Wall-budget guard. Reads the injected util.time_source clock, so a
+    ManualClock test can expire the budget without real sleeps."""
+
     def __init__(self, max_time_seconds):
         self.max_time_seconds = float(max_time_seconds)
         self._start = None
 
     def initialize(self):
-        self._start = time.monotonic()
+        self._start = monotonic_s()
 
     def terminate(self, score):
         if self._start is None:
-            self._start = time.monotonic()
-        return time.monotonic() - self._start >= self.max_time_seconds
+            self._start = monotonic_s()
+        return monotonic_s() - self._start >= self.max_time_seconds
 
     def __repr__(self):
         return f"MaxTimeIterationTerminationCondition({self.max_time_seconds}s)"

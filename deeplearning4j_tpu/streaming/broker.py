@@ -41,6 +41,8 @@ import threading
 import time
 import uuid
 
+from ..util.time_source import monotonic_s
+
 
 class BrokerError(RuntimeError):
     """Broker-side rejection (unknown op, malformed frame, ...)."""
@@ -85,9 +87,10 @@ class MessageBroker:
         self.host = host
         self._requested_port = int(port)
         self.topic_capacity = int(topic_capacity)
-        self._topics = {}
+        self._topics = {}            # guarded by: self._topics_lock
         self._topics_lock = threading.Lock()
-        self._seen_ids = {}  # insertion-ordered id -> None (bounded)
+        # insertion-ordered id -> None (bounded)
+        self._seen_ids = {}          # guarded by: self._topics_lock
         self._server = None
         self._thread = None
         self.port = None
@@ -283,15 +286,31 @@ class BrokerClient:
     def poll(self, topic, timeout=0):
         """Long-poll by looping short server-side waits (each bounded by the
         broker's MAX_POLL_S, far under the socket timeout — a long client
-        timeout can never strand a blocked handler holding a record)."""
+        timeout can never strand a blocked handler holding a record). The
+        deadline reads the injected util.time_source clock: under ManualClock
+        an advanced clock expires the poll with zero real sleeps."""
         cap = MessageBroker.MAX_POLL_S  # single source for both caps
-        deadline = time.monotonic() + float(timeout or 0)
+        deadline = monotonic_s() + float(timeout or 0)
         while True:
-            remaining = deadline - time.monotonic()
+            # the max(0, ...) clamp makes an already-expired deadline (e.g.
+            # a ManualClock advanced mid-poll) a final non-blocking round
+            start = monotonic_s()
+            remaining = deadline - start
+            wait_s = max(0, min(remaining, cap))
+            # real elapsed time per round, deliberately NOT the injected
+            # source: a frozen ManualClock can never expire the deadline on
+            # its own, and the broker's blocking wait is real regardless —
+            # a round that served its full slice with zero injected-clock
+            # progress must end the poll, not spin forever (same escape as
+            # MagicQueue.poll's guard)
+            t0 = time.monotonic()  # graftlint: disable=GL001 (frozen-clock escape needs the real clock)
             msg = self._request({"op": "poll", "topic": topic,
-                                 "timeout": max(0, min(remaining, cap))})["msg"]
-            if msg is not None or time.monotonic() >= deadline:
+                                 "timeout": wait_s})["msg"]
+            if msg is not None or monotonic_s() >= deadline:
                 return msg
+            if monotonic_s() == start and wait_s > 0 \
+                    and time.monotonic() - t0 >= wait_s:  # graftlint: disable=GL001 (frozen-clock escape)
+                return None
 
     def stats(self):
         return self._request({"op": "stat"})["topics"]

@@ -14,13 +14,20 @@ from __future__ import annotations
 
 import json
 
-from ..util.http import BackgroundHttpServer, QuietHandler
+from ..util.http import BackgroundHttpServer, QuietHandler, dumps_http
 from .storage import InMemoryStatsStorage
 
 # report types that are not per-iteration training stats (activation grids,
 # serving-subsystem metrics, telemetry registry flushes) — excluded from
 # score/param time-series views
 _NON_TRAINING_TYPES = ("activations", "serving", "telemetry")
+
+
+def _dumps(obj) -> bytes:
+    """Strict-JSON response body (GL002): a NaN score or an np.float32 in a
+    stats report must serve as valid JSON (non-finite -> null, numpy values
+    via tolist), never as a bare NaN that strict decoders reject."""
+    return dumps_http(obj).encode()
 
 
 def _latest_training(updates):
@@ -65,7 +72,7 @@ class TrainModule(UIModule):
         }
 
     def _json(self, obj):
-        return 200, "application/json", json.dumps(obj).encode()
+        return 200, "application/json", _dumps(obj)
 
     def _sessions(self, query, body):
         return self._json(self.storage.list_session_ids())
@@ -155,7 +162,7 @@ class HistogramModule(UIModule):
             "mean_magnitudes": series,
             "scores": [u.get("score") for u in updates],
         }
-        return 200, "application/json", json.dumps(payload).encode()
+        return 200, "application/json", _dumps(payload)
 
 
 class FlowModule(UIModule):
@@ -181,12 +188,12 @@ class FlowModule(UIModule):
         stats = [u for u in (self.storage.get_all_updates(sid) if sid else [])
                  if u.get("type") not in _NON_TRAINING_TYPES]
         latest = stats[-1] if stats else None
-        return 200, "application/json", json.dumps({
+        return 200, "application/json", _dumps({
             "session": sid,
             "graph": (static or {}).get("graph", {"nodes": [], "edges": []}),
             "score": (latest or {}).get("score"),
             "iteration": (latest or {}).get("iteration"),
-        }).encode()
+        })
 
 
 class ConvolutionalModule(UIModule):
@@ -212,9 +219,9 @@ class ConvolutionalModule(UIModule):
         updates = self.storage.get_all_updates(sid) if sid else []
         for u in reversed(updates):
             if u.get("type") == "activations":
-                return 200, "application/json", json.dumps(u).encode()
-        return 200, "application/json", json.dumps(
-            {"session": sid, "layers": {}}).encode()
+                return 200, "application/json", _dumps(u)
+        return 200, "application/json", _dumps(
+            {"session": sid, "layers": {}})
 
 
 class TsneModule(UIModule):
@@ -238,7 +245,7 @@ class TsneModule(UIModule):
         return 200, "application/json", b'{"status":"ok"}'
 
     def _coords(self, query, body):
-        return 200, "application/json", json.dumps(self._payload).encode()
+        return 200, "application/json", _dumps(self._payload)
 
 
 class MetricsModule(UIModule):
@@ -262,7 +269,7 @@ class MetricsModule(UIModule):
             from ..telemetry.prometheus import CONTENT_TYPE
             return 200, CONTENT_TYPE, self.registry.to_prometheus().encode()
         return (200, "application/json",
-                json.dumps(self.registry.snapshot()).encode())
+                _dumps(self.registry.snapshot()))
 
 
 class HealthModule(UIModule):

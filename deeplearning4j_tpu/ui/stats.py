@@ -17,6 +17,7 @@ import os
 
 import numpy as np
 
+from ..util.http import dumps_http
 from ..util.time_source import monotonic_s, now_ms, now_s
 
 
@@ -63,7 +64,9 @@ class StatsInitReport:
             return {"nodes": [], "edges": []}
 
     def to_json(self):
-        return json.dumps(self.data)
+        # reports are HTTP payloads (POSTed to /remoteReceive, served back by
+        # UI endpoints): strict JSON only — NaN -> null, numpy via tolist
+        return dumps_http(self.data)
 
 
 class StatsReport:
@@ -88,7 +91,7 @@ class StatsReport:
         }
 
     def to_json(self):
-        return json.dumps(self.data)
+        return dumps_http(self.data)
 
     @staticmethod
     def from_json(s):
@@ -112,7 +115,7 @@ class ServingStatsReport:
         }
 
     def to_json(self):
-        return json.dumps(self.data)
+        return dumps_http(self.data)
 
 
 def _array_stats(arr, histogram_bins=20):

@@ -182,7 +182,10 @@ class RemoteUIStatsStorageRouter(StatsStorageRouter):
     def _post(self, d):
         import time
         import urllib.request
-        body = json.dumps(d).encode()
+        from ..util.http import dumps_http
+        # HTTP body (GL002): a NaN score or numpy scalar in a report must
+        # reach the receiver as strict JSON, not break the POST
+        body = dumps_http(d).encode()
         for attempt in range(self.max_retries + 1):
             try:
                 req = urllib.request.Request(

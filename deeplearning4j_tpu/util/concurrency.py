@@ -13,7 +13,8 @@ from __future__ import annotations
 import collections
 import queue
 import threading
-import time
+
+from .time_source import monotonic_s
 
 
 class AtomicCounter:
@@ -22,7 +23,7 @@ class AtomicCounter:
     handler threads — a lost-update data race under ThreadingHTTPServer)."""
 
     def __init__(self, value=0):
-        self._value = int(value)
+        self._value = int(value)   # guarded by: self._lock
         self._lock = threading.Lock()
 
     def add(self, n=1):
@@ -82,18 +83,29 @@ class MagicQueue:
 
     def poll(self, worker, timeout=None):
         """Take the next item for `worker` (device-affine take). Returns None
-        on timeout, or — once the queue is closed and drained — immediately."""
-        deadline = None if timeout is None else time.monotonic() + timeout
+        on timeout, or — once the queue is closed and drained — immediately.
+
+        The deadline reads the injected util.time_source clock, so a test
+        that pre-advances a ManualClock past the deadline gets None with
+        zero real blocking. The condition wait itself is real-time: if a
+        full wait slice elapses with no wake-up and no clock progress (a
+        frozen ManualClock can never expire the deadline on its own), the
+        poll honors the real elapsed time and returns None instead of
+        spinning forever."""
+        deadline = None if timeout is None else monotonic_s() + timeout
         with self._locks[worker]:
             q = self._queues[worker]
             while not q:
                 if self._closed:
                     return None
-                remaining = (None if deadline is None
-                             else deadline - time.monotonic())
-                if remaining is not None and remaining <= 0:
+                if deadline is None:
+                    self._not_empty[worker].wait()
+                    continue
+                remaining = deadline - monotonic_s()
+                if remaining <= 0:
                     return None
-                self._not_empty[worker].wait(remaining)
+                if not self._not_empty[worker].wait(remaining) and not q:
+                    return None   # real slice elapsed, nothing arrived
             item = q.popleft()
             self._not_full[worker].notify()   # one pop frees one slot
             return item
@@ -173,7 +185,7 @@ class ConcurrentHashSet:
     """(reference: parallelism/ConcurrentHashSet.java)"""
 
     def __init__(self):
-        self._set = set()
+        self._set = set()          # guarded by: self._lock
         self._lock = threading.Lock()
 
     def add(self, item):

@@ -13,15 +13,38 @@ import urllib.request
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 
 
-def _sanitize_nonfinite(obj):
-    """Deep-copy `obj` with non-finite floats replaced by None."""
-    if isinstance(obj, float):
+def _sanitize_nonfinite(obj, default=None):
+    """Deep-copy `obj` with non-finite floats replaced by None. Objects the
+    json encoder would hand to `default` (numpy scalars, exceptions, ...) are
+    converted HERE too, so a default that yields a non-finite float (e.g.
+    np.float32('nan').item()) is sanitized instead of re-raising on the
+    second serialization pass."""
+    if isinstance(obj, float):     # incl. np.float64 (a float subclass)
         return obj if math.isfinite(obj) else None
     if isinstance(obj, dict):
-        return {k: _sanitize_nonfinite(v) for k, v in obj.items()}
+        return {k: _sanitize_nonfinite(v, default) for k, v in obj.items()}
     if isinstance(obj, (list, tuple)):
-        return [_sanitize_nonfinite(v) for v in obj]
+        return [_sanitize_nonfinite(v, default) for v in obj]
+    if default is not None and not isinstance(obj, (str, int, bool,
+                                                    type(None))):
+        converted = default(obj)
+        if converted is not obj:   # guard: a no-op default must not recurse
+            return _sanitize_nonfinite(converted, default)
     return obj
+
+
+def json_default(obj):
+    """`default=` for payloads that may carry numpy values: anything
+    .tolist()-able (numpy scalars AND arrays) becomes plain Python numbers/
+    lists — dumps_safe then null-s non-finite ones — and everything else
+    falls back to str so a response is never dropped mid-write."""
+    tolist = getattr(obj, "tolist", None)
+    if callable(tolist):
+        try:
+            return tolist()
+        except (TypeError, ValueError):
+            pass
+    return str(obj)
 
 
 def dumps_safe(obj, default=None) -> str:
@@ -29,12 +52,22 @@ def dumps_safe(obj, default=None) -> str:
     every strict decoder reject): the fast path serializes with
     allow_nan=False, and only a payload that actually contains a non-finite
     float pays the sanitizing second pass (non-finite -> null). `default`
-    passes through to json.dumps (log sinks use default=str)."""
+    passes through to json.dumps (log sinks use default=str; numpy-bearing
+    payloads use default=json_default)."""
     try:
         return json.dumps(obj, allow_nan=False, default=default)
     except ValueError:
-        return json.dumps(_sanitize_nonfinite(obj), allow_nan=False,
+        return json.dumps(_sanitize_nonfinite(obj, default), allow_nan=False,
                           default=default)
+
+
+def dumps_http(obj) -> str:
+    """THE serializer for HTTP payloads that may carry stats/metrics values:
+    dumps_safe with the numpy-aware default pre-applied, so call sites can't
+    forget the `default=json_default` half of the pairing (forgetting it
+    means a numpy scalar raises TypeError mid-response — the exact bug class
+    GL002 exists to prevent)."""
+    return dumps_safe(obj, default=json_default)
 
 
 def send_json(handler: BaseHTTPRequestHandler, status: int, obj,

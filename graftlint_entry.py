@@ -1,0 +1,38 @@
+"""Console entry for graftlint (`[project.scripts] graftlint = ...`).
+
+deeplearning4j_tpu/analysis is stdlib-only, but a plain import of
+``deeplearning4j_tpu.analysis.cli`` executes the parent package __init__ —
+jax and the whole framework, ~2.5s and an ImportError in jax-free lint
+environments. This shim locates the package WITHOUT executing its __init__
+(find_spec reads metadata only for a top-level name), installs an empty
+parent-module stub, and only then imports the analysis subpackage. The
+in-repo `tools/lint.py` wrapper reuses it.
+
+`python -m deeplearning4j_tpu.analysis` remains the full-framework route
+(the -m machinery necessarily imports the parent package).
+"""
+import importlib.util
+import sys
+import types
+
+
+def _stub_parent_package():
+    if "deeplearning4j_tpu" in sys.modules:
+        return
+    spec = importlib.util.find_spec("deeplearning4j_tpu")
+    if spec is None or not spec.submodule_search_locations:
+        raise ImportError("deeplearning4j_tpu package not found on sys.path")
+    pkg = types.ModuleType("deeplearning4j_tpu")
+    pkg.__path__ = list(spec.submodule_search_locations)
+    pkg.__spec__ = spec
+    sys.modules["deeplearning4j_tpu"] = pkg
+
+
+def main(argv=None):
+    _stub_parent_package()
+    from deeplearning4j_tpu.analysis.cli import main as cli_main
+    return cli_main(argv)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

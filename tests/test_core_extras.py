@@ -536,3 +536,22 @@ def test_network_evaluate_top_n():
     Y = np.eye(5, dtype=np.float32)[rng.integers(0, 5, 40)]
     e = net.evaluate(ListDataSetIterator(DataSet(X, Y), batch_size=10), top_n=3)
     assert 0.0 <= e.accuracy() <= e.top_n_accuracy() <= 1.0
+
+
+def test_magic_queue_poll_timeout_under_manual_clock():
+    """GL001 regression: MagicQueue.poll's deadline reads the injected time
+    source, and a frozen ManualClock must NOT turn a timed poll into an
+    infinite loop of real waits — one real slice elapses, then None."""
+    import time as _time
+    from deeplearning4j_tpu.util.time_source import (ManualClock,
+                                                     TimeSourceProvider)
+    TimeSourceProvider.set_instance(ManualClock())
+    try:
+        mq = MagicQueue(1)
+        t0 = _time.monotonic()
+        assert mq.poll(0, timeout=0.05) is None       # empty: bounded wait
+        assert _time.monotonic() - t0 < 5.0           # ...not an infinite spin
+        mq.add("x")
+        assert mq.poll(0, timeout=0.05) == "x"        # item: no wait at all
+    finally:
+        TimeSourceProvider.reset()

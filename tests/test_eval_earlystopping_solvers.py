@@ -294,3 +294,23 @@ def test_early_stopping_graph_trainer_in_memory_saver():
     x = np.asarray(_toy_data(seed=2).next().features)
     np.testing.assert_allclose(np.asarray(best.output(x)[0]),
                                np.asarray(g.output(x)[0]), atol=1e-6)
+
+
+def test_max_time_termination_fires_on_manual_clock():
+    """GL001 regression: MaxTimeIterationTerminationCondition reads the
+    injected util.time_source clock, so the wall budget expires under a
+    ManualClock with zero real sleeps."""
+    from deeplearning4j_tpu.util.time_source import (ManualClock,
+                                                     TimeSourceProvider)
+    clock = ManualClock()
+    TimeSourceProvider.set_instance(clock)
+    try:
+        cond = MaxTimeIterationTerminationCondition(max_time_seconds=30.0)
+        cond.initialize()
+        assert cond.terminate(score=1.0) is False
+        clock.advance(29.0)
+        assert cond.terminate(score=1.0) is False
+        clock.advance(1.5)                       # 30.5s elapsed > 30s budget
+        assert cond.terminate(score=1.0) is True
+    finally:
+        TimeSourceProvider.reset()

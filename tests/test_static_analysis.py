@@ -1,0 +1,464 @@
+"""graftlint tests: every rule (GL001–GL006) detects a seeded violation at
+the right file:line, suppression comments AND baseline entries silence it,
+the baseline round-trips through --baseline-update, and the whole-repo gate
+(package + tools/) runs clean under the committed baseline inside tier-1."""
+import json
+import subprocess
+import sys
+import time
+from pathlib import Path
+
+import pytest
+
+from deeplearning4j_tpu.analysis import Analyzer, Baseline, all_rules, get_rule
+
+REPO = Path(__file__).resolve().parents[1]
+BASELINE_PATH = REPO / "tools" / "lint_baseline.json"
+
+
+def lint(src, rel_path="deeplearning4j_tpu/pkg/mod.py", rules=None):
+    analyzer = Analyzer(rules=[get_rule(r) for r in rules] if rules else None,
+                        root=str(REPO))
+    violations, err = analyzer.analyze_source(src, rel_path)
+    assert err is None, err
+    return violations
+
+
+# one (source, expected rule, expected flagged lines) seed per rule
+SEEDS = {
+    "GL001": ("""\
+import time
+
+def poll_deadline(timeout):
+    return time.monotonic() + timeout
+
+def stamp():
+    return int(time.time() * 1000)
+""", [4, 7]),
+    "GL002": ("""\
+import json
+
+def overview(query, body):
+    return 200, "application/json", json.dumps({"scores": []}).encode()
+""", [4]),
+    "GL003": ("""\
+import threading
+
+class Counter:
+    def __init__(self):
+        self._value = 0   # guarded by: self._lock
+        self._lock = threading.Lock()
+
+    def ok(self):
+        with self._lock:
+            self._value += 1
+            return self._value
+
+    def racy(self):
+        return self._value + 1
+""", [14]),
+    "GL004": ("""\
+import jax
+
+@jax.jit
+def step(params, x):
+    return float(x.sum())
+""", [5]),
+    "GL005": ("""\
+import threading
+
+def start(work):
+    t = threading.Thread(target=work)
+    t.start()
+    return t
+""", [4]),
+    "GL006": ("""\
+import jax
+
+def serve(requests, fn):
+    for r in requests:
+        out = jax.jit(fn)(r)
+    return out
+""", [5]),
+}
+
+
+@pytest.mark.parametrize("rule_id", sorted(SEEDS))
+def test_rule_detects_seeded_violation_at_line(rule_id):
+    src, lines = SEEDS[rule_id]
+    violations = lint(src)
+    flagged = [v for v in violations if v.rule == rule_id]
+    assert [v.line for v in flagged] == lines, violations
+    assert all(v.path.endswith("pkg/mod.py") for v in flagged)
+    # no OTHER rule fires on the seed (rules stay orthogonal)
+    assert [v.rule for v in violations] == [rule_id] * len(lines)
+
+
+@pytest.mark.parametrize("rule_id", sorted(SEEDS))
+def test_inline_suppression_comment_silences(rule_id):
+    src, lines = SEEDS[rule_id]
+    out = []
+    for i, text in enumerate(src.splitlines(), 1):
+        out.append(text + f"  # graftlint: disable={rule_id} <rationale>"
+                   if i in lines else text)
+    assert lint("\n".join(out) + "\n") == []
+
+
+@pytest.mark.parametrize("rule_id", sorted(SEEDS))
+def test_file_suppression_comment_silences(rule_id):
+    src, _ = SEEDS[rule_id]
+    assert lint(f"# graftlint: disable-file={rule_id}\n" + src) == []
+
+
+@pytest.mark.parametrize("rule_id", sorted(SEEDS))
+def test_baseline_entry_silences(rule_id):
+    src, lines = SEEDS[rule_id]
+    violations = lint(src)
+    baseline = Baseline.from_violations(violations)
+    new, matched = baseline.split(violations)
+    assert new == [] and len(matched) == len(lines)
+    # matching is a MULTISET: N entries absorb at most N identical findings,
+    # so duplicating the violating code leaves the copies as new
+    doubled = lint(src + "\n" + src.replace("def ", "def dup_"))
+    extra_new, extra_matched = Baseline.from_violations(violations).split(doubled)
+    assert len(extra_matched) == len(lines) and len(extra_new) == len(lines)
+
+
+def test_standalone_suppression_comment_applies_to_next_line():
+    src = ("import time\n"
+           "# graftlint: disable=GL001 (benchmark of the raw clock itself)\n"
+           "T = time.monotonic()\n")
+    assert lint(src) == []
+
+
+def test_bare_disable_suppresses_every_rule():
+    src, _ = SEEDS["GL001"]
+    marked = src.replace("    return time.monotonic() + timeout",
+                         "    return time.monotonic() + timeout  "
+                         "# graftlint: disable")
+    assert [v.line for v in lint(marked)] == [7]
+
+
+def test_suppression_marker_inside_string_is_ignored():
+    src = ('import time\n'
+           'S = "# graftlint: disable-file=GL001"\n'
+           'T = time.time()\n')
+    assert [v.rule for v in lint(src)] == ["GL001"]
+
+
+# ---------------------------------------------------------------- per-rule
+# edge semantics beyond the shared seed matrix
+
+def test_gl001_allows_time_source_module_and_resolves_aliases():
+    src, _ = SEEDS["GL001"]
+    assert lint(src, rel_path="deeplearning4j_tpu/util/time_source.py") == []
+    aliased = "import time as _t\nx = _t.monotonic()\n"
+    assert [v.rule for v in lint(aliased)] == ["GL001"]
+    assert lint("import time\ntime.sleep(0.1)\n") == []   # sleep is fine
+
+
+def test_gl002_payload_module_and_dataflow_triggers():
+    # every dumps in a payload module is payload serialization
+    src = "import json\n\ndef to_json(d):\n    return json.dumps(d)\n"
+    assert [v.line for v in lint(src, rel_path="deeplearning4j_tpu/ui/stats.py")] \
+        == [4]
+    assert lint(src) == []   # same code elsewhere: no HTTP evidence, quiet
+    # dumps flowing into an HTTP request body through an assignment
+    flow = ("import json\n"
+            "import urllib.request\n\n"
+            "def post(url, d):\n"
+            "    body = json.dumps(d).encode()\n"
+            "    return urllib.request.Request(url, data=body)\n")
+    assert [(v.rule, v.line) for v in lint(flow)] == [("GL002", 5)]
+    # dumps written straight to a handler's wfile
+    wf = ("import json\n\n"
+          "class H:\n"
+          "    def do_GET(self):\n"
+          "        self.wfile.write(json.dumps({'a': 1}).encode())\n")
+    assert [(v.rule, v.line) for v in lint(wf)] == [("GL002", 5)]
+
+
+def test_gl002_allowlist_covers_util_http_only():
+    """Satellite: telemetry/log/alert handlers must keep using dumps_safe —
+    the ONLY module allowed raw json.dumps on a payload path is the strict
+    serializer itself."""
+    from deeplearning4j_tpu.analysis.rules import UnsafeJsonRule
+    assert UnsafeJsonRule.ALLOW == ("util/http.py",)
+    src, _ = SEEDS["GL002"]
+    assert lint(src, rel_path="deeplearning4j_tpu/util/http.py") == []
+
+
+def test_gl002_telemetry_ui_serving_endpoints_are_clean():
+    """Satellite: no telemetry/serving/ui endpoint regresses to raw dumps."""
+    report = Analyzer(rules=[get_rule("GL002")], root=str(REPO)).analyze_paths(
+        ["deeplearning4j_tpu/telemetry", "deeplearning4j_tpu/serving",
+         "deeplearning4j_tpu/ui", "deeplearning4j_tpu/util"])
+    assert report.violations == [] and report.errors == []
+
+
+def test_gl003_lock_guard_semantics():
+    src, _ = SEEDS["GL003"]
+    vs = lint(src)
+    assert "self._value is guarded by self._lock" in vs[0].message
+    # __init__ writes are exempt (no concurrent callers during construction);
+    # a second guarded attribute under a DIFFERENT lock is tracked separately
+    two_locks = ("""\
+import threading
+
+class T:
+    def __init__(self):
+        self._a = 0       # guarded by: self._la
+        self._b = 0       # guarded by: self._lb
+        self._la = threading.Lock()
+        self._lb = threading.Lock()
+
+    def cross(self):
+        with self._la:
+            self._b += 1
+""")
+    vs = lint(two_locks)
+    assert [(v.rule, v.line) for v in vs] == [("GL003", 12)]
+    assert "self._lb" in vs[0].message
+
+
+def test_gl004_partial_jit_and_wrapped_by_name():
+    partial_form = ("""\
+import functools
+import jax
+import numpy as np
+
+@functools.partial(jax.jit, static_argnames=("n",))
+def f(x, n):
+    return np.asarray(x)
+
+def build(g):
+    def inner(x):
+        return x.item()
+    return jax.jit(inner)
+""")
+    vs = lint(partial_form)
+    assert [(v.rule, v.line) for v in vs] == [("GL004", 7), ("GL004", 11)]
+    # the same host-sync calls OUTSIDE jit are fine
+    assert lint("import numpy as np\n\ndef f(x):\n    return np.asarray(x)\n") == []
+
+
+def test_gl005_daemon_or_joined_threads_pass():
+    ok = ("""\
+import threading
+
+class W:
+    def start(self):
+        self._t = threading.Thread(target=self._run)
+        self._t.start()
+
+    def close(self):
+        self._t.join()
+""")
+    assert lint(ok) == []
+    assert lint("import threading\n\n"
+                "def s(w):\n"
+                "    t = threading.Thread(target=w, daemon=True)\n"
+                "    t.start()\n") == []
+    swallow = ("""\
+def worker(q):
+    while True:
+        try:
+            q.step()
+        except Exception:
+            pass
+""")
+    assert [(v.rule, v.line) for v in lint(swallow)] == [("GL005", 5)]
+    # a SPECIFIC exception pass is deliberate control flow, not a swallow
+    assert lint(swallow.replace("except Exception:", "except KeyError:")) == []
+
+
+def test_gl006_cached_handle_idiom_passes():
+    cached = ("""\
+import jax
+
+def serve(requests, fn, cache):
+    for r in requests:
+        if "k" not in cache:
+            cache["k"] = jax.jit(fn)
+        out = cache["k"](r)
+    return out
+""")
+    assert lint(cached) == []
+    # a def boundary stops the loop ancestry (defining a fn in a loop body
+    # doesn't invoke jit per iteration)
+    deferred = ("""\
+import jax
+
+def build(fns):
+    out = []
+    for f in fns:
+        def make(f=f):
+            return jax.jit(f)
+        out.append(make)
+    return out
+""")
+    assert lint(deferred) == []
+
+
+# ---------------------------------------------------------------- baseline
+
+def test_baseline_round_trip_via_cli(tmp_path):
+    """--baseline-update then a clean re-run exits 0; removing the baseline
+    fails the gate again; notes survive the rewrite."""
+    target = tmp_path / "mod.py"
+    target.write_text(SEEDS["GL001"][0])
+    bl = tmp_path / "bl.json"
+
+    def run(*extra):
+        return subprocess.run(
+            [sys.executable, str(REPO / "tools" / "lint.py"), str(target),
+             "--baseline", str(bl), *extra],
+            capture_output=True, text=True, cwd=str(REPO))
+
+    assert run().returncode == 1                      # dirty, no baseline
+    assert run("--baseline-update").returncode == 0   # write baseline
+    assert run().returncode == 0                      # now clean
+    # a note added by a human survives the next --baseline-update
+    data = json.loads(bl.read_text())
+    data["entries"][0]["note"] = "kept: raw-clock benchmark"
+    bl.write_text(json.dumps(data))
+    assert run("--baseline-update").returncode == 0
+    assert "kept: raw-clock benchmark" in bl.read_text()
+    assert run("--no-baseline").returncode == 1       # baseline ignored
+
+
+def test_baseline_matches_by_code_not_line():
+    src, lines = SEEDS["GL001"]
+    baseline = Baseline.from_violations(lint(src))
+    drifted = "# a new comment shifting every line\n" + src
+    new, matched = baseline.split(lint(drifted))
+    assert new == [] and len(matched) == len(lines)
+
+
+def test_stale_baseline_entries_are_detectable():
+    src, _ = SEEDS["GL001"]
+    baseline = Baseline.from_violations(lint(src))
+    fixed = src.replace("time.monotonic() + timeout", "monotonic_s() + timeout")
+    assert len(baseline.stale_entries(lint(fixed))) == 1
+
+
+def test_scoped_baseline_update_preserves_out_of_scope_entries(tmp_path):
+    """A --baseline-update restricted to one path (or rule subset) must not
+    delete entries for files it never analyzed."""
+    a = tmp_path / "a.py"
+    b = tmp_path / "b.py"
+    a.write_text(SEEDS["GL001"][0])
+    b.write_text(SEEDS["GL005"][0])
+    bl = tmp_path / "bl.json"
+
+    def run(*argv):
+        return subprocess.run(
+            [sys.executable, str(REPO / "tools" / "lint.py"),
+             "--baseline", str(bl), *argv],
+            capture_output=True, text=True, cwd=str(REPO))
+
+    assert run(str(a), str(b), "--baseline-update").returncode == 0
+    entries = json.loads(bl.read_text())["entries"]
+    assert {e["rule"] for e in entries} == {"GL001", "GL005"}
+    # scoped re-derive of a.py only: b.py's GL005 entry survives verbatim
+    assert run(str(a), "--baseline-update").returncode == 0
+    after = json.loads(bl.read_text())["entries"]
+    assert {e["rule"] for e in after} == {"GL001", "GL005"}
+    # rule-scoped update keeps the other rule's entries too
+    assert run(str(a), str(b), "--rules", "GL005",
+               "--baseline-update").returncode == 0
+    assert {e["rule"] for e in json.loads(bl.read_text())["entries"]} == \
+        {"GL001", "GL005"}
+    assert run(str(a), str(b)).returncode == 0      # still clean overall
+
+
+def test_baseline_update_refuses_on_parse_errors(tmp_path):
+    """An unparseable file yields zero violations, so updating the baseline
+    past it would silently delete that file's annotated entries — the update
+    must refuse instead."""
+    good = tmp_path / "good.py"
+    good.write_text(SEEDS["GL001"][0])
+    bl = tmp_path / "bl.json"
+
+    def run(*argv):
+        return subprocess.run(
+            [sys.executable, str(REPO / "tools" / "lint.py"),
+             "--baseline", str(bl), *argv],
+            capture_output=True, text=True, cwd=str(REPO))
+
+    assert run(str(tmp_path), "--baseline-update").returncode == 0
+    before = bl.read_text()
+    (tmp_path / "broken.py").write_text("def broken(:\n")
+    proc = run(str(tmp_path), "--baseline-update")
+    assert proc.returncode == 1
+    assert "baseline NOT updated" in proc.stdout
+    assert bl.read_text() == before              # untouched
+
+
+def test_nonexistent_path_fails_loudly(tmp_path):
+    """A typoed path in CI must exit 1, not lint zero files green."""
+    proc = subprocess.run(
+        [sys.executable, str(REPO / "tools" / "lint.py"),
+         str(tmp_path / "no_such_dir")],
+        capture_output=True, text=True, cwd=str(REPO))
+    assert proc.returncode == 1
+    assert "does not exist" in proc.stdout
+
+
+# ---------------------------------------------------------------- CLI + gate
+
+def test_cli_json_format_is_machine_readable(tmp_path):
+    target = tmp_path / "mod.py"
+    target.write_text(SEEDS["GL002"][0])
+    proc = subprocess.run(
+        [sys.executable, "-m", "deeplearning4j_tpu.analysis", str(target),
+         "--no-baseline", "--format=json"],
+        capture_output=True, text=True, cwd=str(REPO))
+    assert proc.returncode == 1
+    out = json.loads(proc.stdout)
+    assert out["ok"] is False and out["files_checked"] == 1
+    (v,) = out["new"]
+    assert v["rule"] == "GL002" and v["line"] == 4 and v["code"]
+
+
+def test_cli_rule_subset_and_list_rules():
+    proc = subprocess.run(
+        [sys.executable, str(REPO / "tools" / "lint.py"), "--list-rules"],
+        capture_output=True, text=True, cwd=str(REPO))
+    assert proc.returncode == 0
+    for rule in all_rules():
+        assert rule.id in proc.stdout and rule.rationale
+    assert [r.id for r in all_rules()] == \
+        ["GL001", "GL002", "GL003", "GL004", "GL005", "GL006"]
+
+
+def test_repo_gate_is_clean_and_fast():
+    """THE gate: the whole package + tools/ lint clean under the committed
+    baseline, in well under the 10s budget."""
+    t0 = time.monotonic()
+    report = Analyzer(root=str(REPO)).analyze_paths(
+        ["deeplearning4j_tpu", "tools"])
+    baseline = Baseline.load(str(BASELINE_PATH))
+    new, _ = baseline.split(report.violations)
+    elapsed = time.monotonic() - t0
+    assert report.errors == []
+    assert new == [], "NEW lint violations (fix, suppress with a rationale " \
+        "comment, or tools/lint.py --baseline-update):\n" + \
+        "\n".join(str(v) for v in new)
+    assert report.files_checked > 100
+    assert elapsed < 10.0, f"lint gate took {elapsed:.1f}s (budget 10s)"
+
+
+def test_committed_baseline_is_note_complete_and_not_stale():
+    """Policy: baselined leftovers must be annotated (why is it tolerated?),
+    must never include GL001/GL002 (those are always fixed for real), and
+    must not outlive the violation they excuse."""
+    baseline = Baseline.load(str(BASELINE_PATH))
+    for entry in baseline.entries:
+        assert entry["note"].strip(), f"baseline entry without a note: {entry}"
+        assert entry["rule"] not in ("GL001", "GL002"), \
+            f"clock/json findings must be FIXED, not baselined: {entry}"
+    report = Analyzer(root=str(REPO)).analyze_paths(
+        ["deeplearning4j_tpu", "tools"])
+    stale = baseline.stale_entries(report.violations)
+    assert stale == [], f"stale baseline entries (already fixed): {stale}"

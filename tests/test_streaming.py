@@ -248,3 +248,61 @@ def test_broker_publish_retry_is_idempotent():
         assert 6.0 < time.monotonic() - t0 < 12.0
     finally:
         broker.stop()
+
+
+def test_broker_client_poll_deadline_reads_time_source():
+    """GL001 regression: BrokerClient.poll's long-poll deadline reads the
+    injected util.time_source clock — a ManualClock expires a 12s poll with
+    zero real sleeps (each simulated broker round advances the clock)."""
+    from deeplearning4j_tpu.streaming.broker import BrokerClient, MessageBroker
+    from deeplearning4j_tpu.util.time_source import (ManualClock,
+                                                     TimeSourceProvider)
+    clock = ManualClock()
+    TimeSourceProvider.set_instance(clock)
+    try:
+        client = BrokerClient(port=1)   # never connected: _request is stubbed
+        calls = []
+
+        def fake_request(obj):
+            calls.append(obj)
+            # simulate the broker-side blocking wait by advancing manual time
+            clock.advance(obj["timeout"] or 1.0)
+            return {"msg": None}
+
+        client._request = fake_request
+        assert client.poll("t", timeout=12.0) is None
+        # 12 manual seconds split into MAX_POLL_S-capped rounds: 5 + 5 + 2
+        assert [c["timeout"] for c in calls] == \
+            [MessageBroker.MAX_POLL_S, MessageBroker.MAX_POLL_S, 2.0]
+
+        # a round that overshoots the deadline ends the poll immediately
+        calls.clear()
+        client._request = lambda obj: (calls.append(obj),
+                                       clock.advance(100.0),
+                                       {"msg": None})[-1]
+        assert client.poll("t", timeout=3.0) is None
+        assert len(calls) == 1
+    finally:
+        TimeSourceProvider.reset()
+
+
+def test_broker_poll_frozen_manual_clock_does_not_hang():
+    """A frozen ManualClock (installed but never advanced) must not turn a
+    timed poll against a REAL broker into an infinite loop: once a round's
+    real blocking wait served the full slice with zero injected-clock
+    progress, poll returns None."""
+    import time as _time
+    from deeplearning4j_tpu.streaming.broker import BrokerClient, MessageBroker
+    from deeplearning4j_tpu.util.time_source import (ManualClock,
+                                                     TimeSourceProvider)
+    broker = MessageBroker(port=0).start()
+    client = BrokerClient(port=broker.port)
+    TimeSourceProvider.set_instance(ManualClock())
+    try:
+        t0 = _time.monotonic()
+        assert client.poll("empty-topic", timeout=0.2) is None
+        assert _time.monotonic() - t0 < 5.0       # bounded, not forever
+    finally:
+        TimeSourceProvider.reset()
+        client.close()
+        broker.stop()

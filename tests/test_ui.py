@@ -291,3 +291,55 @@ def test_ui_server_over_sqlite_storage(tmp_path):
         assert "u1" in sessions
     finally:
         server.stop()
+
+
+def test_ui_endpoints_serve_strict_json_with_nan_and_numpy():
+    """GL002 regression: a stats payload carrying float('nan') and numpy
+    scalars must serve 200 with VALID strict JSON (NaN -> null, np scalars
+    -> numbers) on every UI endpoint — raw json.dumps would emit bare NaN,
+    which json.loads(..., parse_constant=reject) and every strict decoder
+    (JSON.parse, jq) refuse."""
+    storage = InMemoryStatsStorage()
+    server = UIServer(port=0).attach(storage).start()
+    try:
+        storage.put_update({
+            "type": "stats", "session_id": "s-nan", "iteration": 0,
+            "score": float("nan"),                       # diverged run
+            "duration_ms": np.float32(3.5),              # numpy scalar
+            "param_stats": {"w": {"mean_magnitude": np.float32("nan"),
+                                  "mean": float("inf"),
+                                  "histogram": [1, 2],
+                                  "histogram_edges": [-1.0, 1.0]}},
+            "memory": {"rss": np.int64(123)},
+        })
+
+        def reject(_):
+            raise AssertionError("endpoint served bare NaN/Infinity")
+
+        for path in ("/train/overview?sid=s-nan", "/train/model?sid=s-nan",
+                     "/weights/data?sid=s-nan", "/flow/info?sid=s-nan"):
+            with urllib.request.urlopen(server.url + path, timeout=30) as r:
+                assert r.status == 200
+                body = r.read().decode()
+            d = json.loads(body, parse_constant=reject)   # strict-JSON check
+            assert d["session"] == "s-nan"
+        with urllib.request.urlopen(server.url + "/train/overview?sid=s-nan",
+                                    timeout=30) as r:
+            d = json.loads(r.read(), parse_constant=reject)
+        assert d["scores"] == [None]                      # NaN -> null
+        assert d["durations_ms"] == [3.5]                 # np.float32 -> num
+        assert d["memory"]["rss"] == 123                  # np.int64 -> num
+    finally:
+        server.stop()
+
+
+def test_stats_report_to_json_is_strict():
+    """GL002 regression for the report serializers themselves (the payloads
+    POSTed to /remoteReceive)."""
+    from deeplearning4j_tpu.ui.stats import StatsReport
+    r = StatsReport("s", 0, float("nan"),
+                    param_stats={"w": {"max": np.float32("inf")}})
+    d = json.loads(r.to_json(), parse_constant=lambda c: (_ for _ in ()).throw(
+        AssertionError(f"bare {c} in report JSON")))
+    assert d["score"] is None
+    assert d["param_stats"]["w"]["max"] is None

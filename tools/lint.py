@@ -1,0 +1,20 @@
+#!/usr/bin/env python3
+"""graftlint runner: `python tools/lint.py [paths...] [--format=json|text]`.
+
+Thin wrapper so the linter works from a plain checkout without installing
+the package; all behavior lives in deeplearning4j_tpu.analysis.cli (also
+reachable as `python -m deeplearning4j_tpu.analysis` or, when installed, the
+`graftlint` console script). Delegates to graftlint_entry, which loads the
+stdlib-only analysis subpackage WITHOUT executing the jax-heavy package
+__init__ — a lint pass that pre-commit hooks call per commit must start in
+milliseconds.
+"""
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import graftlint_entry  # noqa: E402
+
+if __name__ == "__main__":
+    sys.exit(graftlint_entry.main())

@@ -22,6 +22,10 @@ from pathlib import Path
 
 sys.path.insert(0, str(Path(__file__).resolve().parents[1]))
 
+# graftlint: disable-file=GL001 — this benchmark measures REAL wall-clock
+# latency of live HTTP calls; reading an injectable time source here would
+# zero every measurement under a test-installed ManualClock
+
 
 def run(n_requests=200, concurrency=16, max_rows=4, p99_budget_ms=10000.0,
         hidden=16, seed=0):

@@ -21,6 +21,8 @@ from pathlib import Path
 
 sys.path.insert(0, str(Path(__file__).resolve().parents[1]))
 
+from deeplearning4j_tpu.util.http import dumps_safe  # noqa: E402
+
 REQUIRED_SERIES = ("requests_total", "latency_ms_bucket", "latency_ms_count",
                    "compiles_total", "queue_depth", "batches_total")
 
@@ -66,7 +68,7 @@ def run(n_requests=32, concurrency=8, nin=6, seed=0):
             x = rng.normal(size=(rows, nin)).astype(np.float32)
             req = urllib.request.Request(
                 server.url + "/predict",
-                data=json.dumps({"data": x.tolist()}).encode(),
+                data=dumps_safe({"data": x.tolist()}).encode(),
                 headers={"Content-Type": "application/json"})
             with urllib.request.urlopen(req, timeout=60) as r:
                 out = json.loads(r.read())
