@@ -1,4 +1,4 @@
-"""GL001–GL006: the rule catalog (see RULES.md for the bug-history rationale).
+"""GL001–GL007: the rule catalog (see RULES.md for the bug-history rationale).
 
 Each rule is intra-file AST analysis with light import resolution: aliases
 from ``import x as y`` / ``from m import n as y`` are resolved so
@@ -535,3 +535,76 @@ class PerCallJitRule(Rule):
             if isinstance(anc, ast.stmt):
                 return False
         return False
+
+
+# ---------------------------------------------------------------------------
+# GL007 — ingest-host-widening
+# ---------------------------------------------------------------------------
+
+@register
+class IngestHostWideningRule(Rule):
+    """Host-side float32/float64 widening casts on the ingest hot path."""
+
+    id = "GL007"
+    name = "ingest-host-widening"
+    rationale = (
+        "A host-side astype(np.float32)/np.asarray(..., np.float32) in a "
+        "prefetcher/pipeline worker loop quadruples the bytes every batch "
+        "drags across the host link — BENCH_r05 measured that link as THE "
+        "end-to-end wall (e2e_binding=host_link, chip fed at 7.7% of "
+        "compute). Ship narrow bytes (uint8/int codes) and let the compiled "
+        "step do the widening on-device (etl.device_transform.DeviceIngest "
+        "/ network.set_ingest); a deliberate host-path remainder belongs in "
+        "the baseline with a note.")
+
+    # the ingest hot path: everything running per-batch in these modules is
+    # on (or feeding) a prefetcher/pipeline worker loop
+    HOT_MODULES = ("etl/prefetch.py", "etl/pipeline.py")
+    # elsewhere, only functions that self-identify as worker loops
+    _WORKER_FN = re.compile(r"^(_?worker\w*|\w*_loop|_process|_put)$")
+    _WIDE_QUALS = {"numpy.float32", "numpy.float64"}
+
+    def check(self, ctx):
+        aliases = ctx.aliases
+        hot_module = ctx.rel_path.endswith(self.HOT_MODULES)
+        for node in ctx.nodes:
+            if not isinstance(node, ast.Call):
+                continue
+            fn = enclosing_function(ctx, node)
+            if fn is None:       # module-level constant setup: not per-batch
+                continue
+            if not hot_module and not self._WORKER_FN.match(fn.name):
+                continue
+            wide = self._widening(node, aliases)
+            if wide is not None:
+                yield self.violation(
+                    ctx, node,
+                    f"host-side widening cast to {wide} on the ingest hot "
+                    f"path (`{fn.name}`): ship narrow bytes and cast on "
+                    f"device (etl.device_transform), or baseline with a "
+                    f"note if the wide host path is intentional")
+
+    def _widening(self, node, aliases):
+        """The float32/float64 target of an astype/asarray/array widening
+        call, or None."""
+        if isinstance(node.func, ast.Attribute) and node.func.attr == "astype":
+            cand = node.args[0] if node.args else next(
+                (kw.value for kw in node.keywords if kw.arg == "dtype"), None)
+            return self._float_dtype(cand, aliases)
+        qual = call_qual(node, aliases)
+        if qual in ("numpy.asarray", "numpy.array"):
+            cand = node.args[1] if len(node.args) > 1 else next(
+                (kw.value for kw in node.keywords if kw.arg == "dtype"), None)
+            return self._float_dtype(cand, aliases)
+        return None
+
+    def _float_dtype(self, node, aliases):
+        if node is None:
+            return None
+        qual = qualname(node, aliases)
+        if qual in self._WIDE_QUALS:
+            return qual
+        if isinstance(node, ast.Constant) and node.value in ("float32",
+                                                             "float64"):
+            return node.value
+        return None

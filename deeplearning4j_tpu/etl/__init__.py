@@ -21,12 +21,19 @@ pieces, one import surface:
 - `prefetch` — `DevicePrefetcher`: double/triple-buffered `jax.device_put`
   ahead of the consuming step, with a sharded mode that splits each batch
   across the mesh (parallel/sharding) so `network.fit` and ParallelWrapper
-  receive already-resident, already-sharded arrays.
+  receive already-resident, already-sharded arrays — plus the narrow-wire
+  ingest mode (`transfer_dtype`/`device_transform`/`transfer_streams`).
+- `device_transform` — `DeviceIngest` / `lower_normalizer`: compile a fitted
+  TransformProcess + DataNormalizer into traceable jnp `apply_features` /
+  `apply_labels`, so the host ships raw uint8/int records and the first
+  fused ops of the jitted step do decode/cast/normalize/one-hot ON CHIP
+  (`network.set_ingest`; serving reuses the same lowering per version).
 
 Everything is instrumented through the telemetry layer: per-stage spans,
 `etl_batches_total` / `etl_records_total`, `etl_queue_depth`, and the
 `etl_consumer_wait_ms` histogram (the device-starvation signal).
 """
+from .device_transform import DeviceIngest, lower_normalizer
 from .normalizer import (DataNormalizer, NormalizerMinMaxScaler,
                          NormalizerStandardize)
 from .pipeline import ParallelPipelineExecutor
@@ -37,4 +44,4 @@ from .transform import TransformProcess
 __all__ = ["Schema", "Column", "ColumnType", "TransformProcess",
            "DataNormalizer", "NormalizerStandardize",
            "NormalizerMinMaxScaler", "ParallelPipelineExecutor",
-           "DevicePrefetcher"]
+           "DevicePrefetcher", "DeviceIngest", "lower_normalizer"]

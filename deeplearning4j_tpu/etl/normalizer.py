@@ -90,6 +90,15 @@ class DataNormalizer:
     def _unapply(self, arr, labels):
         raise NotImplementedError
 
+    # ---- device lowering ---------------------------------------------------
+    def device_stats(self, labels=False):
+        """(sub, div, scale, add) float32 affine stats such that
+        `transform == (x - sub) / div * scale + add` — the contract
+        etl.device_transform.lower_normalizer compiles into a traceable jnp
+        closure (on-device serving/ingest preprocessing). Raises when not
+        fitted, exactly like transform()."""
+        raise NotImplementedError
+
     # ---- serialization -----------------------------------------------------
     def to_dict(self):
         raise NotImplementedError
@@ -171,6 +180,11 @@ class NormalizerStandardize(DataNormalizer):
         mean, std = self._stats(labels)
         return (arr * std + mean).astype(np.float32)
 
+    def device_stats(self, labels=False):
+        mean, std = self._stats(labels)
+        one = np.float32(1.0)
+        return mean, std, one, np.float32(0.0)
+
     @property
     def mean(self):
         return self._stats(False)[0]
@@ -245,6 +259,10 @@ class NormalizerMinMaxScaler(DataNormalizer):
         mn, span = self._stats(labels)
         return ((arr - self.lo) / (self.hi - self.lo) * span
                 + mn).astype(np.float32)
+
+    def device_stats(self, labels=False):
+        mn, span = self._stats(labels)
+        return (mn, span, np.float32(self.hi - self.lo), np.float32(self.lo))
 
     def to_dict(self):
         d = {"kind": self.kind, "fit_labels": self.fit_labels,

@@ -170,13 +170,23 @@ class ParallelPipelineExecutor(DataSetIterator):
     per batch. `assemble` overrides the whole records->DataSet step.
     `workers=0` runs every stage inline on next() (debugging / baseline —
     the consumer then waits for the full read+transform cost, which is
-    exactly what the wait-time histogram shows shrinking with workers>0)."""
+    exactly what the wait-time histogram shows shrinking with workers>0).
+
+    `device_ingest=True` flips the pipeline to the NARROW-WIRE mode
+    (etl.device_transform): workers run only the host prefix (filters +
+    categorical string->code encoding) and emit narrow packed DataSets —
+    no float widening, no host normalizer pass, no one-hot expansion. The
+    device suffix (cast/normalize/one-hot) is exposed as `self.ingest`;
+    fuse it into the consuming step via `network.set_ingest(pipe.ingest)`
+    (optionally behind a `DevicePrefetcher`, which then DMAs the narrow
+    bytes). Parity with the wide host path is op-exact to float32
+    (tests/test_device_ingest.py)."""
 
     def __init__(self, reader, transform=None, *, batch_size=32, workers=2,
                  ordered=True, queue_capacity=4, normalizer=None,
                  label_columns=None, one_hot_labels=None, assemble=None,
                  drop_remainder=False, name="etl", registry=None,
-                 tracer=None, health=None):
+                 tracer=None, health=None, device_ingest=False):
         self.reader = reader
         self.transform = transform
         self.batch_size = int(batch_size)
@@ -221,6 +231,19 @@ class ParallelPipelineExecutor(DataSetIterator):
                                  f"schema {self.final_schema.names()}")
         else:
             self.final_schema = None
+        self.ingest = None
+        if device_ingest:
+            if self.assemble is not None:
+                raise ValueError("device_ingest and a custom `assemble` are "
+                                 "mutually exclusive")
+            if self.transform is None:
+                raise ValueError("device_ingest needs a TransformProcess "
+                                 "(the op chain is what gets lowered)")
+            from .device_transform import DeviceIngest
+            self.ingest = DeviceIngest(
+                self.transform, normalizer=self.normalizer,
+                label_columns=self.label_columns,
+                one_hot_labels=self.one_hot_labels)
         self._started = False
         self._consumed_any = False
         # deep-health probe: the pipeline shows up as a component on
@@ -340,6 +363,10 @@ class ParallelPipelineExecutor(DataSetIterator):
 
     # ---- records -> DataSet ------------------------------------------------
     def _process(self, records):
+        if self.ingest is not None:
+            # narrow-wire mode: host prefix + packing only; the widening
+            # (cast/normalize/one-hot) is fused into the consuming jit step
+            return self.ingest.prepare_host(records)
         if self.assemble is not None:
             ds = self.assemble(records)
         elif self.transform is not None:

@@ -1,5 +1,5 @@
 """DevicePrefetcher: double/triple-buffered, optionally SHARDED device_put
-ahead of the consuming train step.
+ahead of the consuming train step — with a NARROW-WIRE ingest mode.
 
 Keeping the TPU fed across the host/device boundary is the canonical input
 bottleneck (the Julia-to-TPU paper's compile/transfer accounting, PAPERS.md),
@@ -16,12 +16,32 @@ stages batch N+1's host->device DMA while the device computes batch N:
   does not divide the data axis fall back to an unsharded put (the trainer's
   wrap-padding then handles them).
 
-`queue_size=2` is classic double buffering; 3 adds one more batch of slack
-for jittery producers. Telemetry: `etl_consumer_wait_ms` (shared with the
-pipeline executor — wait ~0 means the device never starves) and the
-`etl_queue_depth` gauge. A producer error is re-raised exactly once, from
-next()/has_next() or — if the consumer already stopped pulling — from
-reset()/close().
+Ingest mode (BENCH_r05: `e2e_binding=host_link` — the link, not the chip,
+bounds end-to-end training):
+
+- `transfer_dtype=np.uint8` narrows the FEATURE arrays on the host before
+  the DMA (4x fewer wire bytes than float32 for image pixels); pair it with
+  a fused `network.set_ingest` / `device_transform` so the widening cast
+  runs on-chip, where it is one fused XLA op instead of link bytes.
+- `device_transform=fn` applies a traceable/jitted fn (e.g.
+  `DeviceIngest.jit_apply_features`) to each feature array AFTER placement —
+  in sharded mode the input already carries the data-axis NamedSharding, so
+  GSPMD keeps the transform sharded. Prefer fusing into the train step via
+  `network.set_ingest` (ONE executable); this hook is for consumers that
+  can't fuse (evaluation, custom loops).
+- `transfer_streams=S` splits each large feature array into S row chunks
+  `device_put` concurrently: on links where per-transfer latency phases
+  (not wire bandwidth) bound throughput — measured on the bench relay —
+  parallel chunked DMA raises sustained h2d several-fold. Plain/device
+  placement only; sharded placement keeps whole-array puts.
+
+Telemetry: `etl_h2d_bytes_total` counts the bytes that ACTUALLY cross the
+link (post-narrowing), and every batch records an `ingest` span with
+`transfer_ms` vs `transform_ms` legs, so `/metrics` + `/trace` show where
+ingest time goes. `etl_consumer_wait_ms` / `etl_queue_depth` are shared with
+the pipeline executor (wait ~0 means the device never starves). A producer
+error is re-raised exactly once, from next()/has_next() or — if the consumer
+already stopped pulling — from reset()/close().
 """
 from __future__ import annotations
 
@@ -31,6 +51,7 @@ import threading
 from ..datasets.dataset import DataSet, MultiDataSet
 from ..datasets.iterator.base import DataSetIterator
 from ..telemetry.registry import get_registry
+from ..telemetry.trace import get_tracer
 from ..util.time_source import monotonic_s
 
 
@@ -38,7 +59,9 @@ class DevicePrefetcher(DataSetIterator):
     _SENTINEL = object()
 
     def __init__(self, underlying, queue_size=2, device=None, mesh=None,
-                 sharding=None, registry=None, name="prefetch"):
+                 sharding=None, registry=None, name="prefetch",
+                 transfer_dtype=None, device_transform=None,
+                 transfer_streams=1, tracer=None):
         if sum(x is not None for x in (device, mesh, sharding)) > 1:
             raise ValueError("pass at most one of device/mesh/sharding")
         self.underlying = underlying
@@ -47,12 +70,21 @@ class DevicePrefetcher(DataSetIterator):
         self.mesh = mesh
         self.sharding = sharding
         self.name = str(name)
+        self.transfer_dtype = transfer_dtype
+        self.device_transform = device_transform
+        self.transfer_streams = max(1, int(transfer_streams))
+        self._pool = None           # lazy ThreadPoolExecutor for streams > 1
         reg = registry if registry is not None else get_registry()
+        self.tracer = tracer if tracer is not None else get_tracer()
         self._m_wait = reg.histogram(
             "etl_consumer_wait_ms",
             "Time the consumer blocked waiting for the next ETL batch")
         self._m_depth = reg.gauge(
             "etl_queue_depth", "Chunks queued inside ETL pipelines")
+        self._m_bytes = reg.counter(
+            "etl_h2d_bytes_total",
+            "Bytes transferred host->device by ETL prefetchers "
+            "(post-narrowing: what actually crossed the link)")
         self._error_raised = False
         self._start()
 
@@ -68,24 +100,76 @@ class DevicePrefetcher(DataSetIterator):
             return None             # non-divisible batch: unsharded put
         return self.device
 
-    def _put(self, ds):
+    def _transfer(self, a, narrow):
+        """One host array -> device, returning (device_array, host_bytes).
+        Features narrow to `transfer_dtype` BEFORE the DMA; large plain-mode
+        arrays split into `transfer_streams` concurrent chunk puts (latency
+        hiding on links where per-transfer cost, not bandwidth, binds)."""
         import jax
         import numpy as np
+        a = np.asarray(a)
+        if narrow and self.transfer_dtype is not None:
+            a = np.asarray(a, self.transfer_dtype)
+        placement = self._placement_for(a)
+        chunkable = (self.transfer_streams > 1
+                     and self.sharding is None and self.mesh is None
+                     and a.ndim >= 1 and a.shape[0] >= self.transfer_streams
+                     and a.nbytes >= (1 << 20))
+        if not chunkable:
+            return jax.device_put(a, placement), a.nbytes
+        import jax.numpy as jnp
+        chunks = np.array_split(a, self.transfer_streams)
+        futs = [self._pool.submit(jax.device_put, c, placement)
+                for c in chunks]
+        parts = [f.result() for f in futs]
+        return jnp.concatenate(parts, axis=0), a.nbytes
 
-        def put(a):
+    def _put(self, ds):
+        import jax
+        t0 = monotonic_s()
+        nbytes = 0
+
+        def put(a, narrow=False):
+            nonlocal nbytes
             if a is None:
                 return None
-            a = np.asarray(a)
-            return jax.device_put(a, self._placement_for(a))
+            dev, n = self._transfer(a, narrow)
+            nbytes += n
+            return dev
         if isinstance(ds, MultiDataSet):
-            return MultiDataSet(
-                [put(f) for f in ds.features], [put(l) for l in ds.labels],
+            out = MultiDataSet(
+                [put(f, narrow=True) for f in ds.features],
+                [put(l) for l in ds.labels],
                 None if ds.features_masks is None else
                 [None if m is None else put(m) for m in ds.features_masks],
                 None if ds.labels_masks is None else
                 [None if m is None else put(m) for m in ds.labels_masks])
-        return DataSet(put(ds.features), put(ds.labels),
-                       put(ds.features_mask), put(ds.labels_mask))
+            feats = out.features
+        else:
+            out = DataSet(put(ds.features, narrow=True), put(ds.labels),
+                          put(ds.features_mask), put(ds.labels_mask))
+            feats = [out.features]
+        # fence before timestamping: device_put is async, and the span's
+        # transfer leg must mean "DMA done", not "DMA enqueued" (this blocks
+        # only the prefetch worker — the consumer keeps computing)
+        jax.block_until_ready([f for f in feats if f is not None])
+        t1 = monotonic_s()
+        if self.device_transform is not None:
+            tf = self.device_transform
+            if isinstance(out, MultiDataSet):
+                out = MultiDataSet([tf(f) for f in out.features], out.labels,
+                                   out.features_masks, out.labels_masks)
+            else:
+                out = DataSet(tf(out.features), out.labels,
+                              out.features_mask, out.labels_mask)
+            jax.block_until_ready(out.features)
+        t2 = monotonic_s()
+        self._m_bytes.inc(nbytes, pipeline=self.name)
+        self.tracer.record_span(
+            "ingest", t0, t2, pipeline=self.name, bytes=nbytes,
+            transfer_ms=round((t1 - t0) * 1e3, 3),
+            transform_ms=round((t2 - t1) * 1e3, 3))
+        return out
 
     # ---- worker ------------------------------------------------------------
     def _start(self):
@@ -94,6 +178,11 @@ class DevicePrefetcher(DataSetIterator):
         self._error_raised = False
         self._stop = threading.Event()
         stop, q = self._stop, self._queue
+        if self.transfer_streams > 1 and self._pool is None:
+            from concurrent.futures import ThreadPoolExecutor
+            self._pool = ThreadPoolExecutor(
+                max_workers=self.transfer_streams,
+                thread_name_prefix=f"{self.name}-h2d")
 
         def worker():
             try:
@@ -187,6 +276,9 @@ class DevicePrefetcher(DataSetIterator):
         self._join_worker("close")
         self._done = True
         self._peek = None
+        if self._pool is not None:
+            self._pool.shutdown(wait=False)
+            self._pool = None
         err = self._claim_error()
         if err is not None:
             raise err

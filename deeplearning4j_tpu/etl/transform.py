@@ -424,6 +424,13 @@ class TransformProcess:
     def final_schema(self) -> Schema:
         return self._schemas[-1]
 
+    def schema_at(self, i) -> Schema:
+        """Schema ENTERING op i (schema_at(0) = initial, schema_at(len(ops))
+        = final). The device-ingest compiler (etl.device_transform) uses this
+        to split the chain into a host prefix and a jnp-lowered device
+        suffix without re-deriving schemas on the hot path."""
+        return self._schemas[i]
+
     def execute_batch(self, batch):
         """Run the chain vectorized on a column batch; returns the final
         column batch (keys match final_schema().names())."""

@@ -46,6 +46,7 @@ class ComputationGraph(MultiStepTrainable):
         self._rng = jax.random.PRNGKey(conf.seed)
         self._jit_cache = {}
         self._rnn_state = {}
+        self._ingest = None         # device-side ingest fused into the step
 
     @property
     def score_value(self):
@@ -265,12 +266,42 @@ class ComputationGraph(MultiStepTrainable):
             out[name] = g
         return out
 
+    # ------------------------------------------------------- device ingest
+    def set_ingest(self, ingest):
+        """Fuse a device-side ingest transform into the jitted train step
+        (mirrors MultiLayerNetwork.set_ingest): `apply_features` runs on the
+        FIRST network input, `apply_labels` on the FIRST label — the
+        single-input/single-output shape every ingest workload here has.
+        Training paths only; output()/score() keep consuming preprocessed
+        tensors. Clears the jit cache so executables re-trace with the
+        ingest ops fused."""
+        self._ingest = ingest
+        self._jit_cache.clear()
+        return self
+
+    def _apply_ingest(self, inputs, labels):
+        ing = self._ingest
+        if ing is None:
+            return inputs, labels
+        inputs = [ing.apply_features(inputs[0])] + list(inputs[1:])
+        out = []
+        for i, l in enumerate(labels):
+            y = ing.apply_labels(l) if i == 0 else l
+            # restore the non-ingest _prep_batch cast for EVERY label head,
+            # not just the ingested one
+            if y.dtype != self._dtype:
+                y = y.astype(self._dtype)
+            out.append(y)
+        return inputs, out
+
     # ---------------------------------------------------------------- train
     def _make_train_step(self, tbptt=False):
         tx = self._tx
 
         def train_step(params, opt_state, states, rng, inputs, labels, masks,
                        label_masks, carries):
+            inputs, labels = self._apply_ingest(inputs, labels)
+
             def loss_fn(p):
                 return self._loss(p, states, inputs, labels, train=True, rng=rng,
                                   masks=masks, label_masks=label_masks,
@@ -291,15 +322,25 @@ class ComputationGraph(MultiStepTrainable):
             self._jit_cache[key] = self._make_train_step(tbptt=(key == "tbptt"))
         return self._jit_cache[key]
 
-    def fit(self, data, labels=None, epochs=1, steps_per_execution=1):
+    def fit(self, data, labels=None, epochs=1, steps_per_execution=1,
+            prefetch=None, ingest=None):
         """Accepts MultiDataSet / DataSet / iterator thereof / (x, y)
         (reference: fit(DataSetIterator) :671, fit(MultiDataSet) :740).
 
         steps_per_execution=K compiles K optimizer steps into ONE executable
         (lax.scan with donated carry, nn/multistep.py) — one host dispatch
-        per K minibatches; listeners fire on a K-step cadence."""
+        per K minibatches; listeners fire on a K-step cadence.
+
+        prefetch=K wraps the source in an etl.DevicePrefetcher (K-deep
+        device buffer: batch N+1's h2d DMA overlaps batch N's compute);
+        ingest=DeviceIngest(...) fuses device-side decode/cast/one-hot into
+        the compiled step (= set_ingest), so prefetch ships narrow raw bytes
+        — mirrors MultiLayerNetwork.fit."""
         from ...datasets.dataset import DataSet, MultiDataSet
-        from ...datasets.iterator.base import as_iterator, DataSetIterator
+        from ...datasets.iterator.base import (as_iterator, DataSetIterator,
+                                               ListDataSetIterator)
+        if ingest is not None:
+            self.set_ingest(ingest)
         if labels is not None:
             data = MultiDataSet(data, labels)
         if isinstance(data, (DataSet, MultiDataSet)):
@@ -310,20 +351,37 @@ class ComputationGraph(MultiStepTrainable):
             items = list(data)
         else:
             items = as_iterator(data)
+        wrapped = None
+        if prefetch:
+            from ...etl.prefetch import DevicePrefetcher
+            if isinstance(items, list):
+                items = ListDataSetIterator(items)
+            items = wrapped = DevicePrefetcher(items,
+                                               queue_size=int(prefetch))
         K = max(1, int(steps_per_execution))
-        for _ in range(epochs):
-            for listener in self.listeners:
-                listener.on_epoch_start(self)
-            if hasattr(items, "reset"):
-                items.reset()
-            if K > 1:
-                self._fit_grouped(items, K)
-            else:
-                for ds in items:
-                    self.fit_batch(ds)
-            for listener in self.listeners:
-                listener.on_epoch_end(self)
-            self.epoch_count += 1
+        try:
+            for _ in range(epochs):
+                for listener in self.listeners:
+                    listener.on_epoch_start(self)
+                if hasattr(items, "reset"):
+                    items.reset()
+                if K > 1:
+                    self._fit_grouped(items, K)
+                else:
+                    for ds in items:
+                        self.fit_batch(ds)
+                for listener in self.listeners:
+                    listener.on_epoch_end(self)
+                self.epoch_count += 1
+        except BaseException:
+            if wrapped is not None:
+                try:
+                    wrapped.close()
+                except Exception:
+                    pass           # don't mask the primary training error
+            raise
+        if wrapped is not None:
+            wrapped.close()        # stop the fit-owned prefetch thread
         return self
 
     def _prep_batch(self, ds):
@@ -335,7 +393,10 @@ class ComputationGraph(MultiStepTrainable):
                               None if ds.features_mask is None else [ds.features_mask],
                               None if ds.labels_mask is None else [ds.labels_mask])
         inputs = [jnp.asarray(f) for f in ds.features]
-        labels = [jnp.asarray(l, self._dtype) for l in ds.labels]
+        # with a fused ingest, labels ship raw/narrow (e.g. int class ids)
+        # and the one-hot expansion happens inside the compiled step
+        labels = [jnp.asarray(l) for l in ds.labels] if self._ingest is not None \
+            else [jnp.asarray(l, self._dtype) for l in ds.labels]
         masks = None if ds.features_masks is None else \
             [None if m is None else jnp.asarray(m, self._dtype) for m in ds.features_masks]
         lmasks = None if ds.labels_masks is None else \
@@ -343,6 +404,7 @@ class ComputationGraph(MultiStepTrainable):
         return inputs, labels, masks, lmasks
 
     def _scan_loss(self, p, states, inputs, labels, rng, masks, lmasks):
+        inputs, labels = self._apply_ingest(inputs, labels)
         score, (new_states, _) = self._loss(p, states, inputs, labels,
                                             train=True, rng=rng, masks=masks,
                                             label_masks=lmasks)

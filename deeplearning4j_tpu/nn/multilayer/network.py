@@ -53,6 +53,7 @@ class MultiLayerNetwork(MultiStepTrainable):
         self._rng = jax.random.PRNGKey(conf.seed)
         self._rnn_state = {}        # streaming inference carries per layer idx
         self._jit_cache = {}
+        self._ingest = None         # device-side ingest fused into the step
 
     @property
     def score_value(self):
@@ -249,11 +250,45 @@ class MultiLayerNetwork(MultiStepTrainable):
             out[str(i)] = g
         return out
 
+    # ------------------------------------------------------- device ingest
+    def set_ingest(self, ingest):
+        """Fuse a device-side ingest transform (etl.device_transform
+        .DeviceIngest, or any object with traceable `apply_features` /
+        `apply_labels`) into the jitted TRAIN step: batches then ship as raw
+        narrow arrays (uint8/int codes) and decode/cast/normalize/one-hot
+        run as the first fused XLA ops of the step — one executable, no
+        extra dispatch, 4x+ fewer host-link bytes. Training paths only
+        (fit/fit_batch/scanned multistep); output()/score()/solvers keep
+        consuming preprocessed tensors. Clears the jit cache so every
+        executable re-traces with the ingest ops fused."""
+        self._ingest = ingest
+        self._jit_cache.clear()
+        return self
+
+    def _apply_ingest(self, x, y):
+        """Traced at the top of every train step. Post-ingest casts replay
+        the non-ingest `_prep_batch` semantics on device: signed-int inputs
+        (embedding ids) pass through, everything else lands on the param
+        dtype; labels always land on the param dtype."""
+        ing = self._ingest
+        if ing is None:
+            return x, y
+        x = ing.apply_features(x)
+        if not jnp.issubdtype(x.dtype, jnp.signedinteger) \
+                and x.dtype != self._dtype:
+            x = x.astype(self._dtype)
+        y = ing.apply_labels(y)
+        if y.dtype != self._dtype:
+            y = y.astype(self._dtype)
+        return x, y
+
     # ---------------------------------------------------------------- train
     def _make_train_step(self, tbptt=False):
         tx = self._tx
 
         def train_step(params, opt_state, states, rng, x, y, mask, label_mask, carries):
+            x, y = self._apply_ingest(x, y)
+
             def loss_fn(p):
                 return self._loss(p, states, x, y, train=True, rng=rng, mask=mask,
                                   label_mask=label_mask,
@@ -278,7 +313,7 @@ class MultiLayerNetwork(MultiStepTrainable):
         return self._jit_cache[key]
 
     def fit(self, data, labels=None, epochs=1, steps_per_execution=1,
-            prefetch=None):
+            prefetch=None, ingest=None):
         """Train. `data` may be a DataSetIterator-like (including an
         etl.ParallelPipelineExecutor), a DataSet, or (x, y) arrays
         (reference: fit(DataSetIterator) :902 and fit(INDArray,INDArray)).
@@ -294,9 +329,16 @@ class MultiLayerNetwork(MultiStepTrainable):
         prefetch=K wraps the iterator in an etl.DevicePrefetcher with a
         K-deep buffer (2 = double, 3 = triple buffering): batch N+1's
         host->device transfer overlaps batch N's compute, so the jit step
-        traces arrays that are already device-resident."""
+        traces arrays that are already device-resident.
+
+        ingest=DeviceIngest(...) (equivalent to set_ingest beforehand) fuses
+        device-side decode/cast/normalize/one-hot into the SAME compiled
+        step, so prefetch transfers narrow raw bytes and the first fused
+        XLA ops do the widening on-chip."""
         from ...datasets.dataset import DataSet
         from ...datasets.iterator.base import as_iterator
+        if ingest is not None:
+            self.set_ingest(ingest)
         if labels is not None:
             data = DataSet(data, labels)
         it = as_iterator(data)
@@ -333,7 +375,15 @@ class MultiLayerNetwork(MultiStepTrainable):
 
     def _prep_batch(self, ds):
         """(x, y, mask, lmask) as device arrays — the per-step leaves both
-        fit_batch and the scanned multi-step path consume."""
+        fit_batch and the scanned multi-step path consume. With an ingest
+        fused (`set_ingest`) the arrays stay RAW/NARROW — the widening cast
+        happens inside the compiled step, not here."""
+        if self._ingest is not None:
+            x = jnp.asarray(ds.features)
+            y = jnp.asarray(ds.labels)
+            mask = None if ds.features_mask is None else jnp.asarray(ds.features_mask, self._dtype)
+            lmask = None if ds.labels_mask is None else jnp.asarray(ds.labels_mask, self._dtype)
+            return x, y, mask, lmask
         x = jnp.asarray(ds.features, self._dtype) \
             if not str(ds.features.dtype).startswith("int") else jnp.asarray(ds.features)
         y = jnp.asarray(ds.labels, self._dtype)
@@ -342,6 +392,7 @@ class MultiLayerNetwork(MultiStepTrainable):
         return x, y, mask, lmask
 
     def _scan_loss(self, p, states, x, y, rng, mask, lmask):
+        x, y = self._apply_ingest(x, y)
         score, (new_states, _) = self._loss(p, states, x, y, train=True,
                                             rng=rng, mask=mask,
                                             label_mask=lmask)
@@ -413,6 +464,7 @@ class MultiLayerNetwork(MultiStepTrainable):
                 def body(carry, batch):
                     params, opt_state, states, carries = carry
                     x, y, mask, lmask, first, sub = batch
+                    x, y = self._apply_ingest(x, y)
                     carries = jax.tree_util.tree_map(
                         lambda c: jnp.where(first, jnp.zeros_like(c), c),
                         carries)
@@ -429,15 +481,19 @@ class MultiLayerNetwork(MultiStepTrainable):
                     params = optax.apply_updates(params, updates)
                     return (params, opt_state, new_states, new_carries), score
 
-                (params, opt_state, states, _), scores = jax.lax.scan(
+                # final carries ARE an output: the donated carry buffers can
+                # alias them, so donation sticks instead of warning "Some
+                # donated buffers were not usable" (at roofline_util≈1.0,
+                # HBM bytes saved are milliseconds saved — BENCH_r05)
+                (params, opt_state, states, carries), scores = jax.lax.scan(
                     body, (params, opt_state, states, carries), stacked)
-                return params, opt_state, states, scores
+                return params, opt_state, states, carries, scores
             self._jit_cache["multi_tbptt"] = timed_first_call(
                 jax.jit(multi_tbptt, donate_argnums=(0, 1, 2, 3)),
                 "train_step:multi_tbptt")
         B = jax.tree_util.tree_leaves(stacked)[0].shape[1]
         carries = self._zero_carries(B, self._dtype)
-        (self.params, self.opt_state, self.states,
+        (self.params, self.opt_state, self.states, _,
          win_scores) = self._jit_cache["multi_tbptt"](
             self.params, self.opt_state, self.states, carries, stacked)
         # per-batch score = mean over that batch's windows (singles parity)

@@ -121,8 +121,11 @@ class DynamicBatcher:
                 # shape-preserving (normalizers are per-element affine); the
                 # normalizer's own float32 output dtype flows through —
                 # casting back to the request dtype would truncate z-scores
-                # to garbage for integer-typed requests
-                x = np.asarray(entry.transform_features(x))
+                # to garbage for integer-typed requests. Runs ON DEVICE when
+                # the version's normalizer lowers (etl.device_transform):
+                # the raw request bytes cross the link once and the widening
+                # affine is an XLA op, not a host NumPy pass
+                x = entry.transform_features_device(x)
             # observed/compile-accounting key = the POST-transform batch the
             # model actually sees: warmup() replays these, so a hot-swapped
             # version compiles the executable dispatch will really use (a

@@ -43,6 +43,7 @@ class ModelVersion:
         self.path = str(path) if path is not None else None
         self.fmt = fmt                       # zip format.json, when file-backed
         self.transform = transform           # e.g. a fitted DataNormalizer
+        self._device_transform = None        # lazily lowered (False = can't)
         self.loaded_at = now_s()
         self.deployed_at = None
         self.serve_count = AtomicCounter()   # rows served by this version
@@ -55,6 +56,35 @@ class ModelVersion:
         if hasattr(self.transform, "transform_features"):
             return self.transform.transform_features(x)
         return self.transform(x)
+
+    def transform_features_device(self, x):
+        """`transform_features`, but ON DEVICE when the transform lowers
+        (DataNormalizer stats -> a jitted affine, the same lowering training
+        ingest uses — etl.device_transform.lower_normalizer): /predict then
+        ships the request bytes as-is and normalizes on-chip instead of
+        burning a host NumPy pass per batch. Host fallback for transforms
+        that don't lower. Output matches the host path to float32 rounding
+        (shape- and dtype-identical: float32), so the batcher's observed/
+        warm-up keys are unchanged."""
+        if self.transform is None:
+            return x
+        if self._device_transform is None:
+            self._device_transform = self._lower_transform()
+        if self._device_transform is False:     # sentinel: not lowerable
+            return self.transform_features(x)
+        return self._device_transform(x)
+
+    def _lower_transform(self):
+        try:
+            import jax
+            from ..etl.device_transform import lower_normalizer
+            from ..etl.normalizer import DataNormalizer
+            if not isinstance(self.transform, DataNormalizer):
+                return False
+            apply, _ = lower_normalizer(self.transform)
+            return jax.jit(apply)
+        except Exception:
+            return False            # unfitted/exotic transform: host path
 
     def revert_outputs(self, y):
         """Un-normalize model outputs for normalizers fitted with

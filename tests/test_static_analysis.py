@@ -80,6 +80,12 @@ def serve(requests, fn):
         out = jax.jit(fn)(r)
     return out
 """, [5]),
+    "GL007": ("""\
+import numpy as np
+
+def worker_loop(chunk):
+    return np.asarray(chunk, np.float32)
+""", [4]),
 }
 
 
@@ -300,6 +306,52 @@ def build(fns):
     assert lint(deferred) == []
 
 
+def test_gl007_scopes_and_dtype_forms():
+    # every widening form fires inside the ETL hot modules, whatever the
+    # function is called
+    hot = ("""\
+import numpy as np
+
+def assemble(cols):
+    a = np.asarray(cols, np.float32)
+    b = np.array(cols, dtype=np.float64)
+    c = a.astype(np.float32)
+    d = a.astype("float64")
+    e = a.astype(dtype=np.float32)
+    return a, b, c, d, e
+""")
+    vs = lint(hot, rel_path="deeplearning4j_tpu/etl/pipeline.py")
+    assert [(v.rule, v.line) for v in vs] == [("GL007", n)
+                                             for n in (4, 5, 6, 7, 8)]
+    # outside the hot modules only worker-loop-named functions are in scope
+    assert lint(hot) == []
+    loop = hot.replace("def assemble", "def _read_loop")
+    assert [v.rule for v in lint(loop)] == ["GL007"] * 5
+    # narrow/unchanged casts are not widening: no dtype, narrow targets,
+    # module-level constants
+    quiet = ("""\
+import numpy as np
+
+SCALE = np.asarray([1.0], np.float32)
+
+def worker(chunk, dt):
+    a = np.asarray(chunk)
+    b = np.asarray(chunk, np.uint8)
+    c = a.astype(np.int32)
+    d = np.asarray(chunk, dt)
+    return a, b, c, d
+""")
+    assert lint(quiet) == []
+
+
+def test_gl007_prefetcher_put_path_is_narrow():
+    """Satellite gate: the DevicePrefetcher transfer path must never regress
+    to widening on the host — the exact anti-pattern this rule encodes."""
+    report = Analyzer(rules=[get_rule("GL007")], root=str(REPO)).analyze_paths(
+        ["deeplearning4j_tpu/etl/prefetch.py"])
+    assert report.violations == [] and report.errors == []
+
+
 # ---------------------------------------------------------------- baseline
 
 def test_baseline_round_trip_via_cli(tmp_path):
@@ -429,7 +481,7 @@ def test_cli_rule_subset_and_list_rules():
     for rule in all_rules():
         assert rule.id in proc.stdout and rule.rationale
     assert [r.id for r in all_rules()] == \
-        ["GL001", "GL002", "GL003", "GL004", "GL005", "GL006"]
+        ["GL001", "GL002", "GL003", "GL004", "GL005", "GL006", "GL007"]
 
 
 def test_repo_gate_is_clean_and_fast():
