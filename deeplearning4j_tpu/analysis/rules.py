@@ -1,4 +1,4 @@
-"""GL001–GL007: the rule catalog (see RULES.md for the bug-history rationale).
+"""GL001–GL008: the rule catalog (see RULES.md for the bug-history rationale).
 
 Each rule is intra-file AST analysis with light import resolution: aliases
 from ``import x as y`` / ``from m import n as y`` are resolved so
@@ -608,3 +608,40 @@ class IngestHostWideningRule(Rule):
                                                              "float64"):
             return node.value
         return None
+
+
+# ---------------------------------------------------------------------------
+# GL008 — raw-http-client
+# ---------------------------------------------------------------------------
+
+@register
+class RawHttpClientRule(Rule):
+    """Outbound urllib.request / http.client use outside util/http.py."""
+
+    id = "GL008"
+    name = "raw-http-client"
+    rationale = (
+        "util.http.post_json/get_json are THE outbound HTTP choke point: "
+        "they inject the W3C traceparent header (telemetry.propagation), so "
+        "every cross-process hop joins the caller's trace, and they "
+        "serialize strict JSON. A raw urllib.request/http.client call "
+        "bypasses both — the request becomes an untraceable hole in the "
+        "fleet view. A deliberate raw client (bulk artifact download) "
+        "belongs in the baseline with a note.")
+
+    ALLOW = ("util/http.py",)
+    _CLIENT_PREFIXES = ("urllib.request.", "http.client.")
+
+    def check(self, ctx):
+        if ctx.rel_path.endswith(self.ALLOW):
+            return
+        aliases = ctx.aliases
+        for node in ctx.nodes:
+            qual = call_qual(node, aliases)
+            if qual is not None and qual.startswith(self._CLIENT_PREFIXES):
+                yield self.violation(
+                    ctx, node,
+                    f"{qual}() outside util/http.py bypasses the traceparent-"
+                    f"injecting client choke point; use util.http.post_json/"
+                    f"get_json (or baseline a deliberate raw client with a "
+                    f"note)")

@@ -313,7 +313,12 @@ class ComputationGraph(MultiStepTrainable):
             params = optax.apply_updates(params, updates)
             return params, opt_state, new_states, score, out_carries
 
-        return jax.jit(train_step, donate_argnums=(0, 1, 2))
+        # tbptt donates the recurrent carries too (arg 8): out_carries
+        # aliases the incoming h/c buffers across windows instead of fresh
+        # [B, H] allocations (see MultiLayerNetwork._make_train_step); std
+        # passes carries=None — zero leaves, donation is a no-op there
+        donate = (0, 1, 2, 8) if tbptt else (0, 1, 2)
+        return jax.jit(train_step, donate_argnums=donate)
 
     def _get_train_step(self, key="std"):
         """One cached jitted step per mode; jit itself retraces per input

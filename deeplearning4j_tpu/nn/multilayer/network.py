@@ -300,7 +300,14 @@ class MultiLayerNetwork(MultiStepTrainable):
             params = optax.apply_updates(params, updates)
             return params, opt_state, new_states, score, out_carries, grads
 
-        return jax.jit(train_step, donate_argnums=(0, 1, 2))
+        # tbptt also donates the LSTM carries (arg 8): out_carries aliases
+        # the incoming h/c buffers instead of allocating 2*layers fresh
+        # [B, H] arrays per window — the non-scanned sibling of the
+        # multi_tbptt carry donation, same HBM-bytes-are-milliseconds
+        # argument (BENCH_r05 roofline_util~1.0). The std step passes
+        # carries=None (zero pytree leaves), so donating it there is a no-op.
+        donate = (0, 1, 2, 8) if tbptt else (0, 1, 2)
+        return jax.jit(train_step, donate_argnums=donate)
 
     def _get_train_step(self, key):
         if key not in self._jit_cache:

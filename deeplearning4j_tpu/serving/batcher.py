@@ -91,16 +91,21 @@ class DynamicBatcher:
             return
         taken_at = monotonic_s()
         tracer = self.tracer
+        # ONE batch span per coalesced dispatch, root of its OWN trace: the
+        # N request traces attach by span LINKS (exported as Chrome-trace
+        # flow events), not parent edges — the old shape parented the batch
+        # under the first request only, so coalesced followers could not be
+        # attributed to the batch that served them
+        batch_span = tracer.start_span("batch", n_requests=len(batch))
         # queue-wait spans, recorded retroactively from the timestamps the
         # queue already stamps — each parented under its own request context
+        # and linked BOTH ways to the batch span
         for r in batch:
-            tracer.record_span("admission", r.enqueued_at, taken_at,
-                               parent=r.trace_ctx, rows=r.rows)
-        # the batch span parents under the FIRST (oldest) request in the
-        # coalesced batch; its trace therefore shows the full tree while
-        # coalesced followers still get their own admission spans
-        batch_span = tracer.start_span("batch", parent=batch[0].trace_ctx,
-                                       n_requests=len(batch))
+            batch_span.add_link(r.trace_ctx)
+            tracer.record_span(
+                "admission", r.enqueued_at, taken_at, parent=r.trace_ctx,
+                rows=r.rows, batch_span_id=batch_span.span_id,
+                batch_trace_id=batch_span.trace_id).add_link(batch_span)
         # everything up to the split is inside the try: a failure (no model
         # deployed, bad input, model error) must fail THIS batch's futures,
         # never escape and kill the batcher thread
@@ -174,7 +179,11 @@ class DynamicBatcher:
         for r in batch:
             r.complete({"prediction": out[offset:offset + r.rows],
                         "version": version})
-            self.metrics.record_latency((now - r.enqueued_at) * 1000.0)
+            # exemplar: the request's own trace id rides with its latency
+            # observation (batcher thread has no current span of its own)
+            self.metrics.record_latency(
+                (now - r.enqueued_at) * 1000.0,
+                trace_id=getattr(r.trace_ctx, "trace_id", None))
             offset += r.rows
 
     def reset_observed(self):

@@ -56,8 +56,10 @@ class ServingMetrics:
         self.rows.add(n_rows)
         self.batch_size.inc(1, bucket=str(bucket_rows))
 
-    def record_latency(self, ms):
-        self.latency.observe(float(ms))
+    def record_latency(self, ms, trace_id=None):
+        """`trace_id` becomes a bounded exemplar on the latency histogram —
+        the join key from a p99 spike to the exact request trace."""
+        self.latency.observe(float(ms), trace_id=trace_id)
 
     # ---- reading ----------------------------------------------------------
     @staticmethod

@@ -46,6 +46,7 @@ from ..telemetry.alerts import (AlertEngine, RouterAlertSink,
 from ..telemetry.health import HealthMonitor
 from ..telemetry.logging import StructuredLogger
 from ..telemetry.prometheus import CONTENT_TYPE as PROMETHEUS_CONTENT_TYPE
+from ..telemetry.propagation import server_span
 from ..telemetry.trace import Tracer
 from ..telemetry.xla import CompileTracker, register_device_memory_gauges
 from ..util.http import BackgroundHttpServer, QuietHandler
@@ -318,7 +319,22 @@ class ServingServer(BackgroundHttpServer):
         server = self
 
         class Handler(QuietHandler):
+            def _traced(self, fn):
+                """Serve inside a server span with the caller's remote
+                parent when a W3C traceparent header arrived (util.http
+                clients inject it), so client and server spans share ONE
+                trace id."""
+                with server_span(server.tracer, self.headers,
+                                 "http " + self.path.partition("?")[0]):
+                    return fn()
+
             def do_GET(self):
+                self._traced(self._do_get)
+
+            def do_POST(self):
+                self._traced(self._do_post)
+
+            def _do_get(self):
                 u = urlparse(self.path)
                 query = {k: v[0] for k, v in parse_qs(u.query).items()}
                 # default=str: probe detail and log fields are free-form
@@ -355,7 +371,7 @@ class ServingServer(BackgroundHttpServer):
                 else:
                     self.send_json(404, {"error": "not found"})
 
-            def do_POST(self):
+            def _do_post(self):
                 try:
                     if self.path == "/predict":
                         server._handle_predict(self)
@@ -445,6 +461,10 @@ class ServingServer(BackgroundHttpServer):
                 return
             root.set_attribute("status", 200)
             root.set_attribute("version", res["version"])
+            # one structured record per answered request, inside the span:
+            # /logs?trace_id=<id> joins an exemplar/trace straight to it
+            self.logger.debug("predict_ok", rows=root.attributes.get("rows"),
+                              version=res["version"])
         out = res["prediction"]
         handler.send_json(200, {"prediction": out.tolist(),
                                 "shape": list(out.shape),

@@ -279,8 +279,14 @@ class BrokerClient:
                 f"{self.retries + 1} attempts") from last
 
     def publish(self, topic, msg_dict):
-        # unique id makes retry-after-lost-response idempotent broker-side
-        return self._request({"op": "pub", "topic": topic, "msg": msg_dict,
+        # unique id makes retry-after-lost-response idempotent broker-side;
+        # the publisher's active trace context rides in the envelope
+        # (`traceparent` key, telemetry.propagation), so a consumer can
+        # parent/link its processing spans under the producing request —
+        # registry fan-out over the broker stays one traceable flow
+        from ..telemetry.propagation import inject_message
+        return self._request({"op": "pub", "topic": topic,
+                              "msg": inject_message(msg_dict),
                               "id": uuid.uuid4().hex})
 
     def poll(self, topic, timeout=0):

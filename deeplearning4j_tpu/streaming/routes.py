@@ -104,12 +104,27 @@ class ServeRoute:
         return msgs
 
     def _serve_loop(self):
+        from ..telemetry.trace import get_tracer
         while not self._stop.is_set():
             msgs = self._drain_batch()
             if not msgs:
                 continue
             published = 0
+            # one dispatch span per coalesced batch, LINKED to every
+            # consumed record's propagated context (same shape as the
+            # serving batcher): a published prediction carries its input's
+            # traceparent forward, so the producing request's trace spans
+            # publish -> route -> downstream consumer
+            span = get_tracer().start_span("route_dispatch",
+                                           n_messages=len(msgs))
             try:
+                # inside the dead-letter try, and duck-type tolerant: a
+                # custom StreamSource's record only has to carry
+                # .array/.meta — no trace context is a missing link, not a
+                # dead route
+                for m in msgs:
+                    ctx = getattr(m, "trace_context", None)
+                    span.add_link(ctx() if callable(ctx) else ctx)
                 batch = np.concatenate([m.array for m in msgs], axis=0)
                 if self.transform is not None:
                     batch = self.transform(batch)
@@ -117,10 +132,12 @@ class ServeRoute:
                 off = 0
                 for m in msgs:
                     n = m.array.shape[0]
-                    self.sink.publish(NDArrayMessage(preds[off:off + n],
-                                                     m.meta))
+                    self.sink.publish(NDArrayMessage(
+                        preds[off:off + n], m.meta,
+                        traceparent=getattr(m, "traceparent", None)))
                     off += n
                     published += 1
+                span.end()
                 self.processed += len(msgs)
             except Exception as e:
                 # a bad record must not kill the route: report error
@@ -129,6 +146,7 @@ class ServeRoute:
                 # consuming (the Camel route's dead-letter behavior). Error
                 # records are stored as strings, bounded, so a persistent
                 # failure stream can't pin batches/tracebacks in memory.
+                span.set_attribute("error", type(e).__name__).end()
                 if len(self.errors) < 100:
                     self.errors.append(f"{type(e).__name__}: {e}")
                 try:

@@ -34,14 +34,26 @@ def deserialize_array(payload) -> np.ndarray:
 
 class NDArrayMessage:
     """One streaming record: an ndarray plus optional metadata (the analog of
-    the reference's Kafka record with its topic/partition headers)."""
+    the reference's Kafka record with its topic/partition headers).
+    `traceparent` (a W3C header value, telemetry.propagation) survives the
+    wire round-trip so a route's output record still points at the trace of
+    the request that produced its input."""
 
-    def __init__(self, array, meta=None):
+    def __init__(self, array, meta=None, traceparent=None):
         self.array = np.asarray(array)
         self.meta = dict(meta or {})
+        self.traceparent = traceparent
+
+    def trace_context(self):
+        """SpanContext of the producing request, or None."""
+        from ..telemetry.propagation import parse_traceparent
+        return parse_traceparent(self.traceparent)
 
     def to_dict(self) -> dict:
-        return {"array": _array_envelope(self.array), "meta": self.meta}
+        d = {"array": _array_envelope(self.array), "meta": self.meta}
+        if self.traceparent is not None:
+            d["traceparent"] = self.traceparent
+        return d
 
     def to_json(self) -> str:
         return json.dumps(self.to_dict())
@@ -49,4 +61,5 @@ class NDArrayMessage:
     @staticmethod
     def from_json(payload) -> "NDArrayMessage":
         d = json.loads(payload) if isinstance(payload, (str, bytes)) else payload
-        return NDArrayMessage(deserialize_array(d["array"]), d.get("meta"))
+        return NDArrayMessage(deserialize_array(d["array"]), d.get("meta"),
+                              traceparent=d.get("traceparent"))

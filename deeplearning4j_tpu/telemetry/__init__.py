@@ -38,6 +38,20 @@ react:
   training watchdog feeding it (NaN loss/gradients, divergence, step-time
   regression) and the checkpoint-and-halt trigger for FaultTolerantTrainer.
 
+The fleet tier makes every signal above cross-process:
+
+- `propagation` — W3C `traceparent` inject/extract (`SpanContext`): the
+  util/http clients inject the active span's context, server handlers and
+  broker messages extract it, so one request is ONE trace across hosts;
+  span/trace ids are collision-free random hex (kernel CSPRNG).
+- `fleet` — `FleetCollector`/`FleetServer`: poll N peer base-URLs and
+  aggregate `GET /fleet/{metrics,healthz,alerts,trace}` (per-`instance`
+  labels + merged totals, worst-status health with down-peers-as-degraded,
+  one Chrome-trace `pid` lane per host).
+- Histograms carry bounded `(value, trace_id)` exemplars, rendered as
+  OpenMetrics exemplars in the Prometheus exposition and attached to firing
+  alert events — the alert → trace → logs pivot.
+
 The ETL subsystem (deeplearning4j_tpu/etl) instruments through this layer
 too: per-stage spans (etl_read/etl_transform), `etl_batches_total` /
 `etl_records_total`, the `etl_queue_depth` gauge, and the
@@ -47,6 +61,7 @@ working = consumer wait ~0).
 from .alerts import (AlertEngine, AlertRule, LogAlertSink, RouterAlertSink,
                      WebhookAlertSink, default_serving_rules,
                      default_training_rules)
+from .fleet import FleetCollector, FleetServer
 from .health import (DEGRADED, HEALTHY, UNHEALTHY, HealthMonitor,
                      get_monitor, set_monitor)
 from .listener import TelemetryListener, TelemetryReport
@@ -54,25 +69,31 @@ from .logging import (FileJsonSink, LogBuffer, StderrJsonSink,
                       StructuredLogger, get_logger, set_logger)
 from .prometheus import CONTENT_TYPE as PROMETHEUS_CONTENT_TYPE
 from .prometheus import render as render_prometheus
+from .propagation import (SpanContext, extract, extract_message,
+                          format_traceparent, inject, inject_message,
+                          parse_traceparent)
 from .registry import (Counter, Gauge, Histogram, MetricsRegistry,
                        get_registry)
 from .trace import (NOOP_SPAN, Span, Tracer, current_span, enable_tracing,
-                    get_tracer, set_tracer)
+                    get_tracer, new_span_id, new_trace_id, set_tracer)
 from .xla import (CompileTracker, record_jit_compile,
                   register_device_memory_gauges, timed_first_call)
 
 __all__ = ["AlertEngine", "AlertRule", "LogAlertSink", "RouterAlertSink",
            "WebhookAlertSink", "default_serving_rules",
            "default_training_rules",
+           "FleetCollector", "FleetServer",
            "DEGRADED", "HEALTHY", "UNHEALTHY", "HealthMonitor",
            "get_monitor", "set_monitor",
            "FileJsonSink", "LogBuffer", "StderrJsonSink", "StructuredLogger",
            "get_logger", "set_logger",
            "TelemetryListener", "TelemetryReport",
            "PROMETHEUS_CONTENT_TYPE", "render_prometheus",
+           "SpanContext", "extract", "extract_message", "format_traceparent",
+           "inject", "inject_message", "parse_traceparent",
            "Counter", "Gauge", "Histogram", "MetricsRegistry",
            "get_registry",
            "NOOP_SPAN", "Span", "Tracer", "current_span", "enable_tracing",
-           "get_tracer", "set_tracer",
+           "get_tracer", "new_span_id", "new_trace_id", "set_tracer",
            "CompileTracker", "record_jit_compile",
            "register_device_memory_gauges", "timed_first_call"]

@@ -292,10 +292,20 @@ class AlertEngine:
 
     def _event(self, rule, transition, value):
         rule.transitions += 1
-        return {"type": "alert", "rule": rule.name, "state": transition,
-                "severity": rule.severity, "value": value,
-                "threshold": rule.threshold, "kind": rule.kind,
-                "description": rule.description, "time": now_s()}
+        ev = {"type": "alert", "rule": rule.name, "state": transition,
+              "severity": rule.severity, "value": value,
+              "threshold": rule.threshold, "kind": rule.kind,
+              "description": rule.description, "time": now_s()}
+        if transition == FIRING and rule.kind == "threshold" and rule.metric:
+            # a histogram-backed alert carries its freshest exemplars: the
+            # receiver pivots alert -> exemplar trace_id -> /trace + /logs
+            # without scraping anything else
+            m = self.registry.get(rule.metric)
+            if m is not None and getattr(m, "kind", None) == "histogram":
+                ex = m.exemplars(**rule.labels)
+                if ex:
+                    ev["exemplars"] = ex[-3:]
+        return ev
 
     def _notify(self, event):
         if self.logger is not None:

@@ -1,0 +1,434 @@
+"""Fleet aggregation plane: one scrape surface over N telemetry peers.
+
+Every observability endpoint so far describes ONE process: a ServingServer's
+`/metrics`, `/healthz`, `/alerts`, `/trace` each stop at its own registry.
+A multi-replica serving fleet (ROADMAP item 1) needs the cross-host view:
+which replica is slow, which is firing, one merged trace with a lane per
+host. `FleetCollector` polls peer base-URLs over `util.http.get_json` (the
+propagation choke point, so fleet scrapes are themselves traceable) and
+aggregates:
+
+- `metrics()`  — per-`instance` snapshots + merged numeric totals;
+  `prometheus()` re-emits every peer's exposition text with an
+  `instance="<peer>"` label injected into each sample line.
+- `healthz()`  — worst-status aggregation, one component per peer. A DOWN
+  peer is a `degraded` probe (visible, still scraping) — never a 500 from
+  the fleet endpoint itself, and not `unhealthy` (the peer may be
+  restarting; its own load balancer already pulled it).
+- `alerts()`   — merged rule states with an `instance` field, firing first.
+- `trace()`    — merged Chrome trace: each host's spans in a distinct `pid`
+  lane with a `process_name` metadata record, so ui.perfetto.dev shows the
+  fleet timeline host-by-host.
+
+Polling is interval-gated through util.time_source (`maybe_poll`), so a
+ManualClock drives staleness in tests with zero sleeps; `FleetServer`
+exposes the aggregate at `GET /fleet/*`.
+"""
+from __future__ import annotations
+
+import re
+import threading
+from urllib.parse import urlparse
+
+from .health import DEGRADED, HEALTHY, UNHEALTHY, _RANK
+from ..util.http import (BackgroundHttpServer, QuietHandler, get_json,
+                         send_json, send_text)
+from ..util.time_source import monotonic_s, now_s
+
+# the label body must tolerate '}' INSIDE quoted label values (legal in the
+# exposition format): match runs of non-brace/non-quote chars or whole quoted
+# strings with escapes, not just [^}]*
+_PROM_SAMPLE_RE = re.compile(r"^([a-zA-Z_:][a-zA-Z0-9_:]*)"
+                             r"(?:\{((?:[^{}\"]|\"(?:[^\"\\]|\\.)*\")*)\})?"
+                             r"\s+(.*)$")
+
+
+def _peer_name(url):
+    """Default instance label for a peer base URL: host:port."""
+    p = urlparse(url)
+    return p.netloc or url
+
+
+def _health_word(body):
+    """Normalize a peer /healthz body to healthy/degraded/unhealthy."""
+    if not isinstance(body, dict):
+        return DEGRADED
+    word = str(body.get("health") or body.get("status") or "").lower()
+    if word == "ok":
+        word = HEALTHY
+    return word if word in _RANK else DEGRADED
+
+
+def _mergeable_number(v):
+    return isinstance(v, (int, float)) and not isinstance(v, bool)
+
+
+_PERCENTILE_KEY = re.compile(r"^(p\d{1,2}|max|min)$")
+
+
+def _merge_totals(snapshots):
+    """Key-wise sum of the numeric parts of per-instance metric snapshots.
+    Plain numbers sum; dicts of plain numbers sum key-wise UNLESS they carry
+    percentile-shaped keys (p50/p99/max — quantiles of different reservoirs
+    do NOT sum; the per-instance sections keep the honest values). A key
+    whose shape DISAGREES across peers (dict on one, number on another —
+    mixed server versions) keeps the first-seen shape rather than raising;
+    the per-instance sections still show each peer's raw value."""
+    totals = {}
+    for snap in snapshots:
+        if not isinstance(snap, dict):
+            continue
+        for key, v in snap.items():
+            if key == "time":
+                continue
+            if _mergeable_number(v):
+                cur = totals.get(key, 0)
+                if _mergeable_number(cur):
+                    totals[key] = cur + v
+            elif isinstance(v, dict) and v and \
+                    all(_mergeable_number(x) for x in v.values()) and \
+                    not any(_PERCENTILE_KEY.match(str(k)) for k in v):
+                sub = totals.setdefault(key, {})
+                if isinstance(sub, dict):
+                    for k, x in v.items():
+                        sub[k] = sub.get(k, 0) + x
+    return totals
+
+
+def _relabel_prometheus(text, instance):
+    """Peer exposition text with instance="..." injected into every sample
+    line (comments and blank lines pass through; exemplar suffixes after
+    ` # ` are preserved untouched)."""
+    out = []
+    esc = instance.replace("\\", "\\\\").replace('"', '\\"')
+    for line in str(text).splitlines():
+        if not line or line.startswith("#"):
+            out.append(line)
+            continue
+        m = _PROM_SAMPLE_RE.match(line)
+        if m is None:
+            out.append(line)
+            continue
+        name, labels, rest = m.group(1), m.group(2), m.group(3)
+        merged = f'instance="{esc}"' + (f",{labels}" if labels else "")
+        out.append(f"{name}{{{merged}}} {rest}")
+    return out
+
+
+class FleetCollector:
+    """Polls peer telemetry endpoints and serves merged views. `peers` is a
+    list of base URLs (e.g. a ServingServer's `.url`); `names` optionally
+    overrides the instance labels (default host:port)."""
+
+    # (state key, peer path) — _fetch_peer scrapes exactly these, and a peer
+    # is down only when every one of them fails; healthz additionally
+    # records the HTTP status code
+    ENDPOINTS = (("metrics", "/metrics"),
+                 ("healthz", "/healthz"),
+                 ("alerts", "/alerts"),
+                 ("trace", "/trace"),
+                 ("prometheus", "/metrics?format=prometheus"))
+
+    def __init__(self, peers, names=None, interval_s=10.0, timeout_s=2.0):
+        self.peers = [str(p).rstrip("/") for p in peers]
+        names = list(names) if names is not None else [None] * len(self.peers)
+        if len(names) != len(self.peers):
+            raise ValueError("names must match peers 1:1")
+        self.names = [n if n else _peer_name(p)
+                      for n, p in zip(names, self.peers)]
+        if len(set(self.names)) != len(self.names):
+            raise ValueError(f"duplicate instance names: {self.names}")
+        self.interval_s = float(interval_s)
+        self.timeout_s = float(timeout_s)
+        self.polls = 0
+        self._last_poll = None          # monotonic_s of last completed poll
+        self._poll_lock = threading.Lock()
+        self._data_lock = threading.Lock()
+        self._data = {}                 # name -> peer state dict
+
+    # ---- polling -----------------------------------------------------------
+    def _fetch_peer(self, url):
+        """Each endpoint fetches under its OWN try: one missing or slow
+        endpoint (a peer type without /trace -> 404, one timed-out GET) must
+        not classify a live peer as down and discard the data that DID
+        arrive. A peer is `down` only when NO endpoint answered; partial
+        failures keep `up` with per-endpoint detail in `errors`."""
+        state = {"url": url, "status": "up", "error": None}
+        errors = {}
+        for key, path in self.ENDPOINTS:
+            kw = {"with_status": True} if key == "healthz" else {}
+            try:
+                got = get_json(url + path, timeout=self.timeout_s, **kw)
+            except Exception as e:      # connection refused/timeout/bad body
+                errors[key] = f"{type(e).__name__}: {e}"
+                got = (None, None) if key == "healthz" else None
+            if key == "healthz":
+                state["healthz_code"], state["healthz"] = got
+            else:
+                state[key] = got
+        if len(errors) == len(self.ENDPOINTS):   # nothing answered at all
+            state["status"] = "down"
+            state["error"] = errors["metrics"]
+        elif errors:
+            state["errors"] = errors
+        return state
+
+    def poll_once(self):
+        """Fetch every peer now; returns the per-instance state map.
+
+        Peers are swept concurrently (one thread each): a wedged peer costs
+        one peer's worth of timeouts per sweep, not len(peers) of them —
+        _fetch_peer alone is up to 5 sequential GETs at `timeout_s` apiece,
+        and every /fleet/* scrape waits on maybe_poll's single flight."""
+        fresh = {}
+        if len(self.peers) == 1:
+            fresh[self.names[0]] = self._fetch_peer(self.peers[0])
+        else:
+            def fetch_into(name, url):
+                fresh[name] = self._fetch_peer(url)   # per-key dict writes
+            workers = [threading.Thread(target=fetch_into, args=(n, u),
+                                        daemon=True)
+                       for n, u in zip(self.names, self.peers)]
+            for t in workers:
+                t.start()
+            for t in workers:
+                t.join()
+            fresh = {name: fresh[name] for name in self.names}  # stable order
+        with self._data_lock:
+            self._data = fresh
+            self.polls += 1
+            self._last_poll = monotonic_s()
+        return fresh
+
+    def maybe_poll(self):
+        """poll_once() if the cached data is older than `interval_s` (or
+        absent). The check-and-poll is serialized so concurrent fleet scrapes
+        trigger one peer sweep, not one per scrape; staleness reads the
+        injected clock, so ManualClock tests drive re-polls with no sleeps."""
+        with self._poll_lock:
+            with self._data_lock:
+                last = self._last_poll
+            if last is not None and monotonic_s() - last < self.interval_s:
+                return False
+            self.poll_once()
+            return True
+
+    def _snapshot(self):
+        with self._data_lock:
+            return dict(self._data)
+
+    # ---- aggregate views ---------------------------------------------------
+    def metrics(self):
+        data = self._snapshot()
+        instances = {}
+        for name, st in data.items():
+            if st["status"] != "up":
+                instances[name] = {"error": st["error"]}
+            elif st.get("metrics") is None:   # up, but /metrics itself failed
+                instances[name] = {"error": (st.get("errors") or {})
+                                   .get("metrics", "no metrics data")}
+            else:
+                instances[name] = st["metrics"]
+        return {"time": now_s(),
+                "instances": instances,
+                "instances_up": sum(1 for s in data.values()
+                                    if s["status"] == "up"),
+                "instances_down": sum(1 for s in data.values()
+                                      if s["status"] == "down"),
+                "totals": _merge_totals(
+                    [st.get("metrics") for st in data.values()
+                     if st["status"] == "up"])}
+
+    def prometheus(self):
+        """Merged exposition text: every up peer's samples with an
+        `instance` label, regrouped BY METRIC FAMILY (OpenMetrics requires
+        each family's lines contiguous — naive per-peer concatenation would
+        reopen family `requests` after `latency_ms` began and fail strict
+        parsers); HELP/TYPE/UNIT keep only the FIRST peer's line per family
+        (mixed-version peers may word help text differently, and OpenMetrics
+        allows at most one HELP/TYPE/UNIT per family)."""
+        families, order = {}, []       # family -> {comments, samples, kinds}
+
+        def block(fam):
+            if fam not in families:
+                families[fam] = {"comments": [], "samples": [],
+                                 "kinds": set()}
+                order.append(fam)
+            return families[fam]
+
+        for name, st in self._snapshot().items():
+            if st["status"] != "up" or not st.get("prometheus"):
+                continue
+            fam = None
+            for line in _relabel_prometheus(st["prometheus"], name):
+                if not line or line == "# EOF":
+                    continue            # one terminator for the merged doc
+                if line.startswith("#"):
+                    parts = line.split(None, 3)
+                    kind = (parts[1] if len(parts) >= 3 and
+                            parts[1] in ("HELP", "TYPE", "UNIT") else None)
+                    if kind is not None:
+                        fam = parts[2]
+                        b = block(fam)
+                        if kind not in b["kinds"]:
+                            b["kinds"].add(kind)
+                            b["comments"].append(line)
+                    elif fam is not None and \
+                            line not in block(fam)["comments"]:
+                        block(fam)["comments"].append(line)
+                    continue
+                m = _PROM_SAMPLE_RE.match(line)
+                sample = m.group(1) if m else line
+                if fam is None or not (sample == fam or
+                                       sample.startswith(fam + "_")):
+                    fam = sample        # comment-less family: its own block
+                block(fam)["samples"].append(line)
+        lines = []
+        for fam in order:
+            lines.extend(families[fam]["comments"])
+            lines.extend(families[fam]["samples"])
+        # the collector's own liveness series, so a scrape can alert on
+        # fleet_instances_down without parsing JSON
+        data = self._snapshot()
+        up = sum(1 for s in data.values() if s["status"] == "up")
+        lines.append("# HELP fleet_instances_up Peers answering scrapes")
+        lines.append("# TYPE fleet_instances_up gauge")
+        lines.append(f"fleet_instances_up {up}")
+        lines.append("# HELP fleet_instances_down Peers failing scrapes")
+        lines.append("# TYPE fleet_instances_down gauge")
+        lines.append(f"fleet_instances_down {len(data) - up}")
+        lines.append("# EOF")
+        return "\n".join(lines) + "\n"
+
+    def healthz(self):
+        """Worst-status aggregation with one component per peer. Down peers
+        report `degraded` (never a fleet-level 500/unhealthy: the peer's own
+        balancer handles ejection; the fleet view must keep serving)."""
+        components, overall = {}, HEALTHY
+        for name, st in self._snapshot().items():
+            if st["status"] == "down":
+                comp = {"status": DEGRADED, "reason": "peer down",
+                        "error": st["error"], "url": st["url"]}
+            else:
+                word = _health_word(st.get("healthz"))
+                comp = {"status": word, "url": st["url"],
+                        "code": st.get("healthz_code")}
+            components[name] = comp
+            if _RANK[comp["status"]] > _RANK[overall]:
+                overall = comp["status"]
+        return {"status": overall, "time": now_s(), "components": components}
+
+    def alerts(self):
+        """Merged rule lifecycle states, firing first, instance-tagged."""
+        rows, firing = [], 0
+        instances = {}
+        for name, st in self._snapshot().items():
+            if st["status"] != "up" or not isinstance(st.get("alerts"), dict):
+                instances[name] = {"error": (st.get("errors") or {})
+                                   .get("alerts") or st["error"]
+                                   or "no alert data"}
+                continue
+            body = st["alerts"]
+            instances[name] = {"firing": body.get("firing", 0)}
+            firing += int(body.get("firing", 0) or 0)
+            for rule in body.get("rules", []):
+                rows.append({**rule, "instance": name})
+        order = {"firing": 0, "pending": 1, "inactive": 2}
+        rows.sort(key=lambda r: (order.get(r.get("state"), 3),
+                                 str(r.get("name")), r["instance"]))
+        return {"time": now_s(), "firing": firing, "instances": instances,
+                "rules": rows}
+
+    def trace(self):
+        """Merged Chrome trace: peer i's events move to pid lane i with a
+        process_name metadata record, so one ui.perfetto.dev load shows the
+        whole fleet host-by-host (cross-host spans of one trace_id still
+        correlate through their args)."""
+        events, other = [], {}
+        data = self._snapshot()
+        for lane, name in enumerate(self.names):
+            st = data.get(name)
+            if st is None or st["status"] != "up" or \
+                    not isinstance(st.get("trace"), dict):
+                continue
+            events.append({"name": "process_name", "ph": "M", "pid": lane,
+                           "args": {"name": name}})
+            for e in st["trace"].get("traceEvents", []):
+                ev = dict(e)
+                ev["pid"] = lane
+                if ev.get("ph") in ("s", "t", "f") and "id" in ev:
+                    # Chrome/Perfetto bind flow events by (cat, id)
+                    # GLOBALLY, not per pid: namespace each peer's ids so
+                    # host A's request->batch arrow never lands on host B
+                    ev["id"] = f"{lane}:{ev['id']}"
+                events.append(ev)
+            other[name] = st["trace"].get("otherData", {})
+        return {"traceEvents": events, "displayTimeUnit": "ms",
+                "otherData": {"instances": other}}
+
+
+class FleetServer(BackgroundHttpServer):
+    """HTTP front for a FleetCollector:
+
+      GET /fleet/metrics   JSON aggregate (?format=prometheus for merged
+                           instance-labeled exposition text)
+      GET /fleet/healthz   worst-status fleet health; 503 only when some
+                           peer itself reports unhealthy
+      GET /fleet/alerts    merged alert states, firing first
+      GET /fleet/trace     merged Chrome trace, one pid lane per host
+      GET /fleet/peers     raw collector status per peer
+
+    Every GET first calls `maybe_poll()` — the interval gate means a
+    monitoring stack scraping all four endpoints still sweeps the peers at
+    most once per `interval_s`."""
+
+    def __init__(self, peers, names=None, host="127.0.0.1", port=0,
+                 interval_s=10.0, timeout_s=2.0, collector=None):
+        super().__init__(host=host, port=port)
+        self.collector = collector if collector is not None else \
+            FleetCollector(peers, names=names, interval_s=interval_s,
+                           timeout_s=timeout_s)
+
+    def start(self):
+        if self._httpd is not None:
+            return self
+        collector = self.collector
+        from .prometheus import CONTENT_TYPE as PROM_CONTENT_TYPE
+
+        class Handler(QuietHandler):
+            def do_GET(self):
+                from urllib.parse import parse_qs, urlparse
+                u = urlparse(self.path)
+                query = {k: v[0] for k, v in parse_qs(u.query).items()}
+                try:
+                    collector.maybe_poll()
+                    if u.path == "/fleet/metrics":
+                        if query.get("format") == "prometheus":
+                            send_text(self, 200, collector.prometheus(),
+                                      content_type=PROM_CONTENT_TYPE)
+                        else:
+                            send_json(self, 200, collector.metrics(),
+                                      default=str)
+                    elif u.path == "/fleet/healthz":
+                        report = collector.healthz()
+                        send_json(self, 503 if report["status"] == UNHEALTHY
+                                  else 200, report, default=str)
+                    elif u.path == "/fleet/alerts":
+                        send_json(self, 200, collector.alerts(), default=str)
+                    elif u.path == "/fleet/trace":
+                        send_json(self, 200, collector.trace(), default=str)
+                    elif u.path == "/fleet/peers":
+                        send_json(self, 200, {
+                            "peers": {name: {"url": st["url"],
+                                             "status": st["status"],
+                                             "error": st["error"]}
+                                      for name, st in
+                                      collector._snapshot().items()},
+                            "polls": collector.polls}, default=str)
+                    else:
+                        send_json(self, 404, {"error": "not found"})
+                except Exception as e:   # aggregation must never drop a scrape
+                    send_json(self, 500,
+                              {"error": f"{type(e).__name__}: {e}"})
+
+        return self.start_with(Handler)

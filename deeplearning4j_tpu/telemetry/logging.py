@@ -67,8 +67,10 @@ class LogBuffer:
             floor = level_rank(level)
             out = [r for r in out if level_rank(r["level"]) >= floor]
         if trace_id is not None:
-            want = int(trace_id)
-            out = [r for r in out if r.get("trace_id") == want]
+            # trace ids are W3C hex strings (telemetry.trace.new_trace_id);
+            # string compare so /logs?trace_id=<hex> joins against /trace
+            want = str(trace_id)
+            out = [r for r in out if str(r.get("trace_id")) == want]
         if n is not None:
             n = int(n)
             out = out[-n:] if n > 0 else []   # -0 would slice the WHOLE list

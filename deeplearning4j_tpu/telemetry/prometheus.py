@@ -1,14 +1,28 @@
-"""Prometheus text exposition (format version 0.0.4) for a MetricsRegistry.
+"""OpenMetrics text exposition for a MetricsRegistry.
 
 Renders `# HELP` / `# TYPE` headers and one sample line per label-set;
 histograms expand to the standard cumulative `_bucket{le=...}` series plus
 `_sum` and `_count`. This is the scrape side of `/metrics?format=prometheus`
 on both the ServingServer and the UI server (JSON stays the default there
 for back-compat).
+
+Histogram bucket lines carry exemplars when the histogram recorded any
+(` # {trace_id="..."} value timestamp` after the sample): the scrape-side
+join from a latency bucket to the exact trace that landed in it, which
+Grafana/Prometheus render as clickable exemplar points.
+
+Exemplars are only legal in the OpenMetrics format — a scraper picks its
+parser from the response Content-Type, and the classic text/plain 0.0.4
+parser rejects the ` # {...}` suffix outright — so the exposition IS
+OpenMetrics: `application/openmetrics-text` content type, a `# EOF`
+terminator, and counter metric-family names with the `_total` sample
+suffix stripped (the family is `requests`, the sample `requests_total`;
+the spec reserves the suffix and Prometheus' OpenMetrics parser enforces
+it). Prometheus has parsed this format since 2.5 (2018).
 """
 from __future__ import annotations
 
-CONTENT_TYPE = "text/plain; version=0.0.4; charset=utf-8"
+CONTENT_TYPE = "application/openmetrics-text; version=1.0.0; charset=utf-8"
 
 
 def _escape_help(s):
@@ -48,19 +62,40 @@ def _le(bound):
     return "+Inf" if bound == float("inf") else _fmt_value(bound)
 
 
+def _bucket_exemplar(exemplars, lo, hi):
+    """Latest exemplar whose value falls in this bucket's (lo, hi] range,
+    rendered as the OpenMetrics ` # {...} value ts` suffix (or "")."""
+    for e in reversed(exemplars):
+        if lo < e["value"] <= hi:
+            return (f' # {{trace_id="{_escape_label(e["trace_id"])}"}}'
+                    f' {_fmt_value(e["value"])} {_fmt_value(e["time"])}')
+    return ""
+
+
 def render(registry) -> str:
     """The full exposition text for every instrument in `registry`."""
     lines = []
     for m in registry.collect():
-        lines.append(f"# HELP {m.name} {_escape_help(m.help)}")
-        lines.append(f"# TYPE {m.name} {m.kind}")
+        # OpenMetrics counters: the `_total` suffix belongs to the SAMPLE,
+        # not the family — `# TYPE requests counter` / `requests_total 5`
+        family = m.name
+        sample = m.name
+        if m.kind == "counter":
+            family = m.name[:-6] if m.name.endswith("_total") else m.name
+            sample = family + "_total"
+        lines.append(f"# HELP {family} {_escape_help(m.help)}")
+        lines.append(f"# TYPE {family} {m.kind}")
         if m.kind == "histogram":
             for labels, data in m.series():
+                exemplars = data.get("exemplars", ())
+                lo = float("-inf")
                 for bound, cum in data["buckets"]:
                     lines.append(
                         f"{m.name}_bucket"
                         f"{_fmt_labels(labels, {'le': _le(bound)})}"
-                        f" {_fmt_value(cum)}")
+                        f" {_fmt_value(cum)}"
+                        f"{_bucket_exemplar(exemplars, lo, bound)}")
+                    lo = bound
                 lines.append(f"{m.name}_sum{_fmt_labels(labels)}"
                              f" {_fmt_value(data['sum'])}")
                 lines.append(f"{m.name}_count{_fmt_labels(labels)}"
@@ -70,6 +105,7 @@ def render(registry) -> str:
             if not series:
                 continue
             for labels, value in series:
-                lines.append(f"{m.name}{_fmt_labels(labels)}"
+                lines.append(f"{sample}{_fmt_labels(labels)}"
                              f" {_fmt_value(value)}")
+    lines.append("# EOF")
     return "\n".join(lines) + "\n"

@@ -1,0 +1,157 @@
+"""W3C trace-context propagation: carry a span's identity across processes.
+
+Everything in `telemetry/` was single-process until this module: the Tracer's
+current-span context is a thread-local, so a trace died at every HTTP hop and
+every broker frame. Here the active span's identity travels as a `traceparent`
+header (https://www.w3.org/TR/trace-context/):
+
+    traceparent: 00-<32 hex trace-id>-<16 hex span-id>-<2 hex flags>
+
+- `inject(headers)` stamps the CURRENT span's context into an outbound
+  header dict (util.http.post_json/get_json call it on every request — the
+  one choke point graftlint GL008 protects).
+- `extract(headers)` parses an inbound header into a `SpanContext`, which any
+  Tracer accepts as `parent=`: the server-side span then carries the caller's
+  trace_id, so one request is ONE trace across client and server `/trace`
+  exports and `/logs` correlation.
+- `inject_message`/`extract_message` do the same for broker message dicts
+  (streaming registry fan-out), under a `traceparent` key in the envelope.
+
+Parsing is deliberately forgiving in exactly one direction: anything
+malformed — wrong version, truncated, bad hex, all-zero ids — degrades to
+"no parent" (None), NEVER an exception. A bad header from a foreign client
+must not 500 the request it decorates.
+"""
+from __future__ import annotations
+
+import re
+from contextlib import contextmanager
+
+from .trace import current_span
+
+HEADER = "traceparent"
+_TRACEPARENT_RE = re.compile(
+    r"^00-([0-9a-f]{32})-([0-9a-f]{16})-([0-9a-f]{2})$")
+
+
+class SpanContext:
+    """A remote span identity: just enough to parent under (`Tracer.span(...,
+    parent=ctx)` reads only .trace_id/.span_id)."""
+
+    __slots__ = ("trace_id", "span_id")
+
+    def __init__(self, trace_id, span_id):
+        self.trace_id = trace_id
+        self.span_id = span_id
+
+    def __repr__(self):
+        return f"SpanContext({self.trace_id}, {self.span_id})"
+
+    def __eq__(self, other):
+        return (isinstance(other, SpanContext)
+                and other.trace_id == self.trace_id
+                and other.span_id == self.span_id)
+
+
+def format_traceparent(span_or_ctx) -> str | None:
+    """The traceparent header value for a span/context, or None when it has
+    no identity (NOOP_SPAN, None)."""
+    if span_or_ctx is None:
+        return None
+    trace_id = getattr(span_or_ctx, "trace_id", None)
+    span_id = getattr(span_or_ctx, "span_id", None)
+    if trace_id is None or span_id is None:
+        return None
+    return f"00-{trace_id}-{span_id}-01"
+
+
+def parse_traceparent(value) -> SpanContext | None:
+    """Parse a traceparent header value; ANY malformation (wrong version,
+    truncated, non-hex, all-zero ids) returns None — never raises."""
+    if not isinstance(value, str):
+        return None
+    m = _TRACEPARENT_RE.match(value.strip().lower())
+    if m is None:
+        return None
+    trace_id, span_id = m.group(1), m.group(2)
+    if trace_id == "0" * 32 or span_id == "0" * 16:
+        return None                    # all-zero ids are explicitly invalid
+    return SpanContext(trace_id, span_id)
+
+
+def _header_value(headers, name):
+    """Case-insensitive header lookup that works for plain dicts AND
+    email.message.Message (what http.server hands out, already
+    case-insensitive)."""
+    if headers is None:
+        return None
+    get = getattr(headers, "get", None)
+    if get is not None:
+        v = get(name)
+        if v is not None:
+            return v
+    try:
+        items = headers.items()
+    except AttributeError:
+        return None
+    for k, v in items:
+        if str(k).lower() == name:
+            return v
+    return None
+
+
+def inject(headers, span=None):
+    """Stamp the span's (default: thread-current span's) context into a
+    mutable header dict; returns the dict. No active context = no header.
+    A header already carrying a traceparent wins — a relay forwarding an
+    explicit context must not sever the originating request's trace with
+    its own (same rule inject_message enforces)."""
+    if _header_value(headers, HEADER) is not None:
+        return headers
+    value = format_traceparent(span if span is not None else current_span())
+    if value is not None:
+        headers[HEADER] = value
+    return headers
+
+
+def extract(headers) -> SpanContext | None:
+    """SpanContext from an inbound header collection, or None."""
+    return parse_traceparent(_header_value(headers, HEADER))
+
+
+@contextmanager
+def server_span(tracer, headers, name):
+    """Run an HTTP handler body inside a server span with the caller's
+    REMOTE parent, iff the request carried a traceparent header — the one
+    pattern both ServingServer and UIServer handlers need, kept here so a
+    propagation change (tracestate, sampling flags) lands once. Requests
+    without the header pay a single header lookup and open no span."""
+    ctx = extract(headers)
+    if ctx is None:
+        yield None
+        return
+    with tracer.span(name, parent=ctx, remote=True) as span:
+        yield span
+
+
+def inject_message(msg_dict, span=None):
+    """Copy of a broker/streaming message dict with the active trace context
+    under a `traceparent` key. The original dict passes through untouched
+    when there is no context (the hot publish path pays a copy only when
+    actually traced) or when the message already carries one (a relay must
+    not overwrite the originating request's context with its own)."""
+    if isinstance(msg_dict, dict) and HEADER in msg_dict:
+        return msg_dict
+    value = format_traceparent(span if span is not None else current_span())
+    if value is None:
+        return msg_dict
+    out = dict(msg_dict)
+    out[HEADER] = value
+    return out
+
+
+def extract_message(msg_dict) -> SpanContext | None:
+    """SpanContext from a message dict's `traceparent` key, or None."""
+    if not isinstance(msg_dict, dict):
+        return None
+    return parse_traceparent(msg_dict.get(HEADER))

@@ -19,6 +19,7 @@ from __future__ import annotations
 
 import threading
 
+from .trace import current_span
 from ..util.time_source import now_s
 
 
@@ -158,16 +159,21 @@ DEFAULT_LATENCY_BUCKETS_MS = (1.0, 2.5, 5.0, 10.0, 25.0, 50.0, 100.0, 250.0,
 
 
 class _HistState:
-    __slots__ = ("count", "sum", "bucket_counts", "reservoir", "_cap")
+    __slots__ = ("count", "sum", "bucket_counts", "reservoir", "_cap",
+                 "exemplars", "_ex_cap")
 
-    def __init__(self, n_buckets, reservoir_cap):
+    def __init__(self, n_buckets, reservoir_cap, exemplar_cap):
         self.count = 0
         self.sum = 0.0
         self.bucket_counts = [0] * n_buckets   # non-cumulative, per bound
         self.reservoir = []                    # most-recent cap samples
         self._cap = reservoir_cap
+        # bounded latest-wins (value, trace_id) exemplars: the join key from
+        # a metric anomaly back to its /trace spans and /logs records
+        self.exemplars = []
+        self._ex_cap = exemplar_cap
 
-    def observe(self, v, bounds):
+    def observe(self, v, bounds, trace_id=None):
         self.count += 1
         self.sum += v
         for i, b in enumerate(bounds):
@@ -177,6 +183,11 @@ class _HistState:
         self.reservoir.append(v)
         if len(self.reservoir) > self._cap:
             del self.reservoir[:len(self.reservoir) - self._cap]
+        if trace_id is not None and self._ex_cap > 0:
+            self.exemplars.append({"value": v, "trace_id": trace_id,
+                                   "time": now_s()})
+            if len(self.exemplars) > self._ex_cap:
+                del self.exemplars[:len(self.exemplars) - self._ex_cap]
 
 
 class Histogram(_Instrument):
@@ -185,12 +196,14 @@ class Histogram(_Instrument):
 
     kind = "histogram"
     RESERVOIR = 4096
+    EXEMPLARS = 10      # per label-set: bounded, latest-wins
 
     def __init__(self, name, help="", buckets=DEFAULT_LATENCY_BUCKETS_MS,
-                 reservoir=RESERVOIR):
+                 reservoir=RESERVOIR, exemplars=EXEMPLARS):
         super().__init__(name, help)
         self.bounds = tuple(sorted(float(b) for b in buckets))
         self.reservoir_cap = int(reservoir)
+        self.exemplar_cap = int(exemplars)
         self._states = {}
 
     def _state(self, labels):
@@ -198,15 +211,34 @@ class Histogram(_Instrument):
         st = self._states.get(key)
         if st is None:
             st = self._states[key] = _HistState(len(self.bounds) + 1,
-                                                self.reservoir_cap)
+                                                self.reservoir_cap,
+                                                self.exemplar_cap)
         return st
 
-    def observe(self, value, **labels):
+    def observe(self, value, trace_id=None, **labels):
+        """Record one observation. `trace_id` (or, by default, the calling
+        thread's current span) becomes a bounded OpenMetrics exemplar —
+        the pointer from "p99 spiked" to the exact trace that spiked it."""
         v = float(value)
+        if trace_id is None:
+            span = current_span()
+            if span is not None:
+                trace_id = span.trace_id
         with self._lock:
             st = self._state(labels)
             bounded = self.bounds + (float("inf"),)
-            st.observe(v, bounded)
+            st.observe(v, bounded, trace_id=trace_id)
+
+    def exemplars(self, **labels):
+        """Recorded exemplars, oldest first: one label-set's when labels are
+        given, else the union across every label-set (the alert-rule read)."""
+        with self._lock:
+            if labels:
+                st = self._states.get(_labelkey(labels))
+                return [dict(e) for e in st.exemplars] if st else []
+            out = [e for st in self._states.values() for e in st.exemplars]
+        out.sort(key=lambda e: e["time"])
+        return [dict(e) for e in out]
 
     def count(self, **labels):
         with self._lock:
@@ -251,7 +283,8 @@ class Histogram(_Instrument):
         return out
 
     def series(self):
-        """[(labels, {"count", "sum", "buckets": [(le, cumulative)...]})]."""
+        """[(labels, {"count", "sum", "buckets": [(le, cumulative)...],
+        "exemplars": [...]})]."""
         with self._lock:
             out = []
             for key, st in sorted(self._states.items()):
@@ -261,7 +294,9 @@ class Histogram(_Instrument):
                     cum += c
                     buckets.append((b, cum))
                 out.append((dict(key), {"count": st.count, "sum": st.sum,
-                                        "buckets": buckets}))
+                                        "buckets": buckets,
+                                        "exemplars": [dict(e) for e in
+                                                      st.exemplars]}))
             return out
 
 
@@ -321,6 +356,9 @@ class MetricsRegistry:
             if m.kind == "histogram":
                 d = m.percentiles()
                 d["sum"] = m.sum()
+                ex = m.exemplars()
+                if ex:
+                    d["exemplars"] = ex
                 out[m.name] = d
             else:
                 series = m.series()

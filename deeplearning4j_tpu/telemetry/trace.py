@@ -21,24 +21,38 @@ Design notes:
   an existing timing never means timing it twice.
 - Clocks come from util/time_source (monotonic for durations, wall for the
   trace epoch), so a ManualClock makes span tests deterministic.
+- Ids are W3C-sized random hex (128-bit trace / 64-bit span) from the
+  kernel CSPRNG — collision-free across threads, forks, and hosts, and
+  directly usable in `traceparent` headers (telemetry/propagation.py).
+  `parent=` accepts any object with .trace_id/.span_id, including a remote
+  SpanContext extracted from an inbound header.
+- Spans can also LINK to other spans (`add_link`) — the batch<->request
+  association without a parent edge; links export as Chrome-trace flow
+  events.
 """
 from __future__ import annotations
 
 import collections
-import itertools
 import json
+import os
 import threading
 
 from ..util.time_source import monotonic_s, now_s
 
-_ids = itertools.count(1)
-_id_lock = threading.Lock()
 _tls = threading.local()          # .span: innermost active Span, any tracer
 
 
-def _next_id():
-    with _id_lock:
-        return next(_ids)
+def new_trace_id() -> str:
+    """W3C-sized 128-bit trace id as 32 lowercase hex chars. os.urandom reads
+    the kernel CSPRNG, so ids never collide across forked/parallel processes
+    (the old process-local itertools.count restarted at 1 in every process —
+    two hosts' traces merged into one indistinguishable id space)."""
+    return os.urandom(16).hex()
+
+
+def new_span_id() -> str:
+    """W3C-sized 64-bit span id as 16 lowercase hex chars."""
+    return os.urandom(8).hex()
 
 
 def current_span():
@@ -51,20 +65,24 @@ class Span:
     end() it manually for cross-thread lifetimes."""
 
     __slots__ = ("tracer", "name", "trace_id", "span_id", "parent_id",
-                 "attributes", "start_mono", "end_mono", "_prev", "_on_stack")
+                 "attributes", "links", "start_mono", "end_mono", "_prev",
+                 "_on_stack")
 
     def __init__(self, tracer, name, parent=None, attributes=None,
                  start_mono=None):
         self.tracer = tracer
         self.name = str(name)
-        self.span_id = _next_id()
-        if parent is not None:
+        self.span_id = new_span_id()
+        if parent is not None and parent.trace_id is not None:
+            # `parent` may be a local Span or a remote SpanContext extracted
+            # from a traceparent header — only .trace_id/.span_id are read
             self.trace_id = parent.trace_id
             self.parent_id = parent.span_id
         else:
-            self.trace_id = _next_id()
+            self.trace_id = new_trace_id()
             self.parent_id = None
         self.attributes = dict(attributes or {})
+        self.links = []
         self.start_mono = monotonic_s() if start_mono is None else start_mono
         self.end_mono = None
         self._prev = None
@@ -72,6 +90,16 @@ class Span:
 
     def set_attribute(self, key, value):
         self.attributes[str(key)] = value
+        return self
+
+    def add_link(self, ctx):
+        """Record a LINK to another span (batch<->request association without
+        a parent edge: the linked span stays the root of its own trace).
+        `ctx` is anything with .trace_id/.span_id (Span, SpanContext); a
+        None/contextless ctx is ignored so callers never need to guard."""
+        if ctx is not None and getattr(ctx, "trace_id", None) is not None:
+            self.links.append({"trace_id": ctx.trace_id,
+                               "span_id": ctx.span_id})
         return self
 
     @property
@@ -105,13 +133,16 @@ class Span:
         return False
 
     def to_dict(self):
-        return {"name": self.name, "trace_id": self.trace_id,
-                "span_id": self.span_id, "parent_id": self.parent_id,
-                "start_ms": round((self.start_mono - self.tracer.epoch_mono)
-                                  * 1000.0, 3),
-                "duration_ms": None if self.duration_ms is None
-                else round(self.duration_ms, 3),
-                "attributes": dict(self.attributes)}
+        d = {"name": self.name, "trace_id": self.trace_id,
+             "span_id": self.span_id, "parent_id": self.parent_id,
+             "start_ms": round((self.start_mono - self.tracer.epoch_mono)
+                               * 1000.0, 3),
+             "duration_ms": None if self.duration_ms is None
+             else round(self.duration_ms, 3),
+             "attributes": dict(self.attributes)}
+        if self.links:
+            d["links"] = [dict(l) for l in self.links]
+        return d
 
 
 class _NoopSpan:
@@ -122,8 +153,12 @@ class _NoopSpan:
     trace_id = span_id = parent_id = None
     name = ""
     attributes = {}
+    links = ()
 
     def set_attribute(self, key, value):
+        return self
+
+    def add_link(self, ctx):
         return self
 
     def end(self, end_mono=None):
@@ -209,9 +244,18 @@ class Tracer:
         """Chrome-trace ("traceEvents") dict: complete ("X") events with
         microsecond timestamps relative to the tracer epoch. Loadable by
         chrome://tracing and ui.perfetto.dev; span/parent ids ride in args
-        so the tree survives the flat event encoding."""
+        so the tree survives the flat event encoding. Trace ids are random
+        hex, so each distinct trace is assigned a small integer `tid` lane
+        at export time (chrome's tid must be numeric); span LINKS export as
+        flow-event pairs (ph "s"/"f") connecting the linked span's slice to
+        the linking span's slice across lanes."""
+        spans = self.finished_spans()
+        lanes = {}                     # trace_id -> small int lane
         events = []
-        for s in self.finished_spans():
+        by_span_id = {}
+        for s in spans:
+            by_span_id[s.span_id] = s
+            lane = lanes.setdefault(s.trace_id, len(lanes) + 1)
             events.append({
                 "name": s.name,
                 "ph": "X",
@@ -219,10 +263,28 @@ class Tracer:
                 "dur": round(((s.end_mono or s.start_mono) - s.start_mono)
                              * 1e6, 1),
                 "pid": 0,
-                "tid": s.trace_id,
+                "tid": lane,
                 "args": {"span_id": s.span_id, "parent_id": s.parent_id,
                          "trace_id": s.trace_id, **s.attributes},
             })
+        flow_n = 0
+        for s in spans:
+            for link in s.links:
+                src = by_span_id.get(link["span_id"])
+                if src is None:        # linked span evicted or remote: skip
+                    continue
+                flow_n += 1
+                common = {"cat": "link", "name": "link", "id": flow_n,
+                          "pid": 0}
+                events.append({**common, "ph": "s", "tid": lanes[src.trace_id],
+                               "ts": round((src.start_mono - self.epoch_mono)
+                                           * 1e6, 1),
+                               "args": {"span_id": src.span_id}})
+                events.append({**common, "ph": "f", "bp": "e",
+                               "tid": lanes[s.trace_id],
+                               "ts": round((s.start_mono - self.epoch_mono)
+                                           * 1e6, 1),
+                               "args": {"span_id": s.span_id}})
         return {"traceEvents": events, "displayTimeUnit": "ms",
                 "otherData": {"epoch_wall_s": self.epoch_wall,
                               "dropped_spans": self.dropped}}

@@ -417,7 +417,15 @@ class UIServer(BackgroundHttpServer):
                     return
                 length = int(self.headers.get("Content-Length", 0))
                 body = self.rfile.read(length) if length else b""
-                status, ctype, content = handler(query, body)
+                # W3C traceparent from util.http clients: serve inside a
+                # server span with the remote parent, so the caller's trace
+                # continues through this process's spans and /logs records
+                # (the process-default tracer is a no-op unless enabled)
+                from ..telemetry.propagation import server_span
+                from ..telemetry.trace import get_tracer
+                with server_span(get_tracer(), self.headers,
+                                 f"http {u.path}"):
+                    status, ctype, content = handler(query, body)
                 self.send_response(status)
                 self.send_header("Content-Type", ctype)
                 self.send_header("Content-Length", str(len(content)))

@@ -181,18 +181,13 @@ class RemoteUIStatsStorageRouter(StatsStorageRouter):
 
     def _post(self, d):
         import time
-        import urllib.request
-        from ..util.http import dumps_http
-        # HTTP body (GL002): a NaN score or numpy scalar in a report must
-        # reach the receiver as strict JSON, not break the POST
-        body = dumps_http(d).encode()
+        # util.http.post_json is the outbound choke point (GL008): strict
+        # JSON body (NaN scores/numpy scalars survive, GL002) AND the
+        # current trace context injected as a traceparent header
+        from ..util.http import post_json
         for attempt in range(self.max_retries + 1):
             try:
-                req = urllib.request.Request(
-                    self.url, data=body,
-                    headers={"Content-Type": "application/json"})
-                with urllib.request.urlopen(req, timeout=5) as resp:
-                    resp.read()
+                post_json(self.url, d, timeout=5)
                 return True
             except Exception:
                 if attempt == self.max_retries:

@@ -9,6 +9,7 @@ from __future__ import annotations
 import json
 import math
 import threading
+import urllib.error
 import urllib.request
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 
@@ -95,16 +96,18 @@ def send_text(handler: BaseHTTPRequestHandler, status: int, text,
     handler.wfile.write(payload)
 
 
-def post_json(url, obj, timeout=5.0, headers=None):
-    """Client-side JSON POST (webhook sinks, remote routers): returns the
-    decoded JSON response body, or None for an empty body. Uses the same
-    non-finite sanitization as send_json."""
-    body = dumps_safe(obj).encode()
-    hdrs = {"Content-Type": "application/json"}
-    hdrs.update(headers or {})
-    req = urllib.request.Request(url, data=body, headers=hdrs)
-    with urllib.request.urlopen(req, timeout=timeout) as resp:
-        data = resp.read()
+def _client_headers(headers):
+    """Outbound header dict with the current trace context injected. This is
+    THE propagation choke point (graftlint GL008 keeps raw urllib out of the
+    rest of the tree): every post_json/get_json call made inside a Tracer
+    span carries a W3C `traceparent` header, so the receiving server's span
+    joins the caller's trace."""
+    hdrs = dict(headers or {})
+    from ..telemetry.propagation import inject
+    return inject(hdrs)
+
+
+def _decode_response(data):
     if not data:
         return None
     try:
@@ -112,6 +115,41 @@ def post_json(url, obj, timeout=5.0, headers=None):
     except ValueError:
         # a 2xx ack with a non-JSON body ("ok") is still a success
         return data.decode(errors="replace")
+
+
+def post_json(url, obj, timeout=5.0, headers=None):
+    """Client-side JSON POST (webhook sinks, remote routers, predict
+    clients): returns the decoded JSON response body, or None for an empty
+    body. Serializes with dumps_http (strict JSON + numpy-aware default) and
+    injects the current trace context as a `traceparent` header."""
+    body = dumps_http(obj).encode()
+    hdrs = {"Content-Type": "application/json"}
+    hdrs.update(_client_headers(headers))
+    req = urllib.request.Request(url, data=body, headers=hdrs)
+    with urllib.request.urlopen(req, timeout=timeout) as resp:
+        data = resp.read()
+    return _decode_response(data)
+
+
+def get_json(url, timeout=5.0, headers=None, with_status=False):
+    """Client-side JSON GET with trace-context injection (the scrape/poll
+    half of post_json — fleet collection, smoke tools, health probes).
+
+    Default: returns the decoded body, raising urllib.error.HTTPError on
+    error statuses like any urllib client. `with_status=True` returns
+    `(status, decoded_body)` and decodes error-status bodies instead of
+    raising — a deep-health 503 response IS the payload a fleet collector
+    wants, not an exception."""
+    req = urllib.request.Request(url, headers=_client_headers(headers))
+    try:
+        with urllib.request.urlopen(req, timeout=timeout) as resp:
+            status, data = resp.status, resp.read()
+    except urllib.error.HTTPError as e:
+        if not with_status:
+            raise
+        status, data = e.code, e.read()
+    decoded = _decode_response(data)
+    return (status, decoded) if with_status else decoded
 
 
 def read_body(handler: BaseHTTPRequestHandler) -> bytes:

@@ -1,0 +1,513 @@
+"""Fleet-scope observability: W3C traceparent propagation, collision-free
+random hex ids, batch span links, histogram exemplars, and the fleet
+aggregation plane (telemetry/propagation.py + telemetry/fleet.py).
+
+The acceptance test at the bottom drives the whole ISSUE-7 loop live:
+client post_json -> /predict -> batcher dispatch is ONE trace across client
+and server spans with the request linked to its batch; /fleet/trace over two
+live servers renders two pid lanes; a firing alert's payload carries an
+exemplar trace_id whose spans and /logs records are retrievable.
+"""
+import multiprocessing
+import random
+
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu.telemetry import (AlertRule, FleetCollector,
+                                          FleetServer, MetricsRegistry,
+                                          SpanContext, Tracer, extract,
+                                          extract_message,
+                                          format_traceparent, inject,
+                                          inject_message, parse_traceparent)
+from deeplearning4j_tpu.telemetry.trace import (get_tracer, new_span_id,
+                                                new_trace_id)
+from deeplearning4j_tpu.util.http import get_json, post_json
+from deeplearning4j_tpu.util.time_source import (ManualClock,
+                                                 TimeSourceProvider)
+
+
+@pytest.fixture
+def manual_clock():
+    clock = ManualClock(start_s=1000.0)
+    TimeSourceProvider.set_instance(clock)
+    try:
+        yield clock
+    finally:
+        TimeSourceProvider.reset()
+
+
+class StubModel:
+    def output(self, x):
+        return np.asarray(x) * 2.0
+
+
+# ------------------------------------------------------------- traceparent
+
+def test_traceparent_roundtrip_and_w3c_shape():
+    t = Tracer(enabled=True)
+    with t.span("op") as s:
+        assert len(s.trace_id) == 32 and len(s.span_id) == 16
+        int(s.trace_id, 16), int(s.span_id, 16)      # valid hex
+        header = format_traceparent(s)
+        assert header == f"00-{s.trace_id}-{s.span_id}-01"
+        ctx = parse_traceparent(header)
+        assert ctx == SpanContext(s.trace_id, s.span_id)
+        # a span parented on the extracted context continues the SAME trace
+        child = Tracer(enabled=True).start_span("remote_child", parent=ctx)
+        assert child.trace_id == s.trace_id
+        assert child.parent_id == s.span_id
+        child.end()
+
+
+def test_traceparent_malformed_inputs_degrade_to_no_parent():
+    """Property sweep: every malformation — truncations at any byte, wrong
+    version, flipped separators, non-hex, all-zero ids, non-strings — parses
+    to None, never raises."""
+    good = f"00-{'ab' * 16}-{'cd' * 8}-01"
+    assert parse_traceparent(good) is not None
+    # truncation at EVERY length short of a full header
+    for n in range(len(good)):
+        assert parse_traceparent(good[:n]) is None, n
+    # wrong version bytes
+    for version in ("01", "ff", "0", "000", "zz"):
+        assert parse_traceparent(
+            f"{version}-{'ab' * 16}-{'cd' * 8}-01") is None
+    # all-zero trace/span ids are explicitly invalid per W3C
+    assert parse_traceparent(f"00-{'0' * 32}-{'cd' * 8}-01") is None
+    assert parse_traceparent(f"00-{'ab' * 16}-{'0' * 16}-01") is None
+    # random single-character corruptions that break the grammar
+    rng = random.Random(0)
+    corrupted = 0
+    for _ in range(300):
+        i = rng.randrange(len(good))
+        c = rng.choice("ghijkxyz!-_ GHXYZ")
+        mutated = good[:i] + c + good[i + 1:]
+        ctx = parse_traceparent(mutated)         # must never raise
+        if ctx is None:
+            corrupted += 1
+        else:
+            # a hex-for-hex swap can stay valid; it must still be w3c-shaped
+            assert len(ctx.trace_id) == 32 and len(ctx.span_id) == 16
+    assert corrupted > 200           # the sweep mostly produced real garbage
+    # non-string junk
+    for junk in (None, 7, b"00-" + b"ab" * 16, ["00"], {"v": 1}):
+        assert parse_traceparent(junk) is None
+
+
+def test_extract_is_case_insensitive_and_never_raises():
+    ctx = SpanContext("ab" * 16, "cd" * 8)
+    hdr = format_traceparent(ctx)
+    assert extract({"traceparent": hdr}) == ctx
+    assert extract({"TraceParent": hdr}) == ctx
+    assert extract({}) is None
+    assert extract(None) is None
+    assert extract({"traceparent": "garbage"}) is None
+
+
+def test_inject_without_active_span_adds_nothing():
+    headers = {}
+    assert inject(headers) == {} and headers == {}
+    t = Tracer(enabled=True)
+    with t.span("op") as s:
+        inject(headers)
+        assert parse_traceparent(headers["traceparent"]).trace_id == s.trace_id
+
+
+def test_inject_never_overwrites_a_relayed_traceparent():
+    """A relay forwarding an explicit caller context inside its own span
+    must not sever the originating trace (same rule as inject_message)."""
+    original = f"00-{'a' * 32}-{'b' * 16}-01"
+    t = Tracer(enabled=True)
+    with t.span("relay"):
+        headers = inject({"traceparent": original})
+        assert headers["traceparent"] == original
+        mixed = inject({"Traceparent": original})   # case-insensitive lookup
+        assert "traceparent" not in mixed and mixed["Traceparent"] == original
+
+
+def test_message_injection_preserves_existing_context():
+    t = Tracer(enabled=True)
+    msg = {"payload": 1}
+    assert inject_message(msg) is msg            # no active span: untouched
+    with t.span("producer") as s:
+        out = inject_message(msg)
+        assert out is not msg and "traceparent" not in msg
+        assert extract_message(out).trace_id == s.trace_id
+        # a relay re-publishing a message must NOT stamp its own context
+        # over the originating request's
+        relayed = inject_message(out)
+        assert extract_message(relayed).span_id == s.span_id
+
+
+# ------------------------------------------------------------- id hygiene
+
+def _child_ids(q):
+    # an adversarially-seeded random module must not influence the ids:
+    # os.urandom reads the kernel CSPRNG, unaffected by fork or seeding
+    random.seed(1234)
+    q.put([new_trace_id() for _ in range(200)]
+          + [new_span_id() for _ in range(200)])
+
+
+def test_ids_never_collide_across_forked_processes():
+    """The old `_next_id` was a process-local counter restarting at 1 — two
+    hosts' traces collided id-for-id. Random hex ids from the kernel CSPRNG
+    must be disjoint across forked children even with random reseeded."""
+    ctx = multiprocessing.get_context("fork")
+    queues, procs = [], []
+    for _ in range(2):
+        q = ctx.Queue()
+        p = ctx.Process(target=_child_ids, args=(q,))
+        p.start()
+        queues.append(q)
+        procs.append(p)
+    sets = [set(q.get(timeout=30)) for q in queues]
+    for p in procs:
+        p.join(30)
+    random.seed(1234)
+    parent = set([new_trace_id() for _ in range(200)]
+                 + [new_span_id() for _ in range(200)])
+    assert sets[0].isdisjoint(sets[1])
+    assert parent.isdisjoint(sets[0] | sets[1])
+    assert all(len(s) == 400 for s in sets + [parent])   # none within either
+
+
+# ------------------------------------------------------------- span links
+
+def test_batch_links_export_as_flow_events_with_integer_lanes():
+    t = Tracer(enabled=True)
+    with t.span("request_a") as a:
+        pass
+    with t.span("request_b") as b:
+        pass
+    batch = t.start_span("batch", n_requests=2)
+    batch.add_link(a).add_link(b).add_link(None)     # None ctx: ignored
+    batch.end()
+    assert batch.to_dict()["links"] == [
+        {"trace_id": a.trace_id, "span_id": a.span_id},
+        {"trace_id": b.trace_id, "span_id": b.span_id}]
+    ct = t.to_chrome_trace()
+    xs = [e for e in ct["traceEvents"] if e["ph"] == "X"]
+    assert all(isinstance(e["tid"], int) for e in xs)   # hex ids -> int lanes
+    assert len({e["tid"] for e in xs}) == 3             # three traces, 3 lanes
+    flows = [e for e in ct["traceEvents"] if e.get("cat") == "link"]
+    # two links -> two s/f pairs
+    assert sorted(e["ph"] for e in flows) == ["f", "f", "s", "s"]
+    by_id = {}
+    for e in flows:
+        by_id.setdefault(e["id"], []).append(e)
+    assert all(len(pair) == 2 for pair in by_id.values())
+
+
+# -------------------------------------------------------------- exemplars
+
+def test_exemplar_reservoir_bounded_under_10k_observations():
+    reg = MetricsRegistry()
+    h = reg.histogram("latency_ms")
+    for i in range(10_000):
+        h.observe(float(i % 97), trace_id=f"trace-{i}",
+                  route=f"r{i % 3}")                   # 3 label-sets
+    for r in range(3):
+        ex = h.exemplars(route=f"r{r}")
+        assert len(ex) == h.exemplar_cap == 10
+        # latest-wins: the newest observations for that label-set survive
+        tail = [e["trace_id"] for e in ex]
+        expect = [f"trace-{i}" for i in range(10_000)
+                  if i % 3 == r][-10:]
+        assert tail == expect
+    assert len(h.exemplars()) == 30                    # merged, still bounded
+    # observations without any trace context record NO exemplar
+    h2 = reg.histogram("plain_ms")
+    h2.observe(5.0)
+    assert h2.exemplars() == []
+    snap = reg.snapshot()
+    assert len(snap["latency_ms"]["exemplars"]) == 30
+
+
+def test_exemplars_render_as_openmetrics_and_auto_capture_current_span():
+    reg = MetricsRegistry()
+    h = reg.histogram("latency_ms", buckets=(1.0, 10.0))
+    t = Tracer(enabled=True)
+    with t.span("slow_request") as s:
+        h.observe(7.5)                  # trace id auto-captured from context
+    text = reg.to_prometheus()
+    line = next(l for l in text.splitlines()
+                if l.startswith('latency_ms_bucket{le="10"}'))
+    assert f'# {{trace_id="{s.trace_id}"}} 7.5' in line
+    # the 1.0 bucket saw nothing: no exemplar suffix
+    low = next(l for l in text.splitlines()
+               if l.startswith('latency_ms_bucket{le="1"}'))
+    assert "#" not in low
+
+
+def test_histogram_threshold_alert_event_carries_exemplars():
+    from deeplearning4j_tpu.telemetry.alerts import AlertEngine
+    reg = MetricsRegistry()
+    h = reg.histogram("latency_ms")
+    h.observe(5000.0, trace_id="slow-trace")
+    engine = AlertEngine(registry=reg, interval_s=0, rules=[
+        AlertRule("lat", metric="latency_ms", percentile=0.99,
+                  threshold=100.0)])
+    events = engine.evaluate()
+    assert len(events) == 1 and events[0]["state"] == "firing"
+    assert [e["trace_id"] for e in events[0]["exemplars"]] == ["slow-trace"]
+
+
+# ------------------------------------------------------- fleet aggregation
+
+def test_fleet_collector_manual_clock_two_servers_one_dead(manual_clock):
+    """Two live in-process servers + one dead peer, ManualClock-driven
+    re-poll gating — zero real sleeps."""
+    from deeplearning4j_tpu.serving import ServingServer
+    s1 = ServingServer(StubModel(), port=0).start()
+    s2 = ServingServer(StubModel(), port=0).start()
+    try:
+        post_json(s1.url + "/predict", {"data": [[1.0, 2.0]]}, timeout=30)
+        dead = "http://127.0.0.1:9"      # discard port: refused instantly
+        fc = FleetCollector([s1.url, s2.url, dead],
+                            names=["a", "b", "dead"], interval_s=30.0,
+                            timeout_s=2.0)
+        assert fc.maybe_poll() is True
+        assert fc.maybe_poll() is False          # cached: inside interval
+        manual_clock.advance(31.0)
+        assert fc.maybe_poll() is True           # stale by the manual clock
+        assert fc.polls == 2
+
+        m = fc.metrics()
+        assert m["instances_up"] == 2 and m["instances_down"] == 1
+        assert m["totals"]["requests"] == 1      # summed over up instances
+        assert "error" in m["instances"]["dead"]
+
+        h = fc.healthz()
+        # dead peer is DEGRADED — visible but never a fleet-level failure
+        assert h["status"] == "degraded"
+        assert h["components"]["dead"]["status"] == "degraded"
+        assert h["components"]["a"]["status"] == "healthy"
+
+        tr = fc.trace()
+        lanes = {e["pid"] for e in tr["traceEvents"]}
+        assert lanes == {0, 1}                   # one lane per LIVE host
+        names = {e["args"]["name"] for e in tr["traceEvents"]
+                 if e["ph"] == "M"}
+        assert names == {"a", "b"}
+
+        text = fc.prometheus()
+        assert 'instance="a"' in text and 'instance="b"' in text
+        assert "fleet_instances_up 2" in text
+        assert "fleet_instances_down 1" in text
+
+        al = fc.alerts()
+        assert set(al["instances"]) == {"a", "b", "dead"}
+        assert all(r["instance"] in ("a", "b") for r in al["rules"])
+    finally:
+        s1.stop()
+        s2.stop()
+
+
+def test_fleet_collector_rejects_misconfigured_names():
+    with pytest.raises(ValueError):
+        FleetCollector(["http://x:1", "http://y:1"], names=["one"])
+    with pytest.raises(ValueError):
+        FleetCollector(["http://x:1", "http://y:1"], names=["same", "same"])
+
+
+def test_one_failing_endpoint_does_not_mark_a_live_peer_down(monkeypatch):
+    """A peer serving /metrics + /healthz but not /trace (404, or one
+    timed-out GET) must stay `up` with its fetched data intact — only a
+    peer answering NOTHING is down."""
+    import deeplearning4j_tpu.telemetry.fleet as fleet_mod
+
+    def fake_get_json(url, timeout=None, with_status=False):
+        if url.endswith("/trace"):
+            raise OSError("HTTP Error 404: Not Found")
+        if with_status:
+            return 200, {"status": "ok"}
+        if url.endswith("format=prometheus"):
+            return "# HELP requests r\n# TYPE requests counter\n" \
+                   "requests_total 3\n# EOF\n"
+        if url.endswith("/alerts"):
+            return {"firing": 0, "rules": []}
+        return {"requests": 3}
+
+    monkeypatch.setattr(fleet_mod, "get_json", fake_get_json)
+    fc = FleetCollector(["http://peer:1"], names=["p"])
+    state = fc.poll_once()["p"]
+    assert state["status"] == "up"
+    assert state["metrics"] == {"requests": 3}
+    assert "trace" in state["errors"] and len(state["errors"]) == 1
+
+    m = fc.metrics()
+    assert m["instances_up"] == 1 and m["totals"]["requests"] == 3
+    assert fc.healthz()["components"]["p"]["status"] == "healthy"
+    assert fc.trace()["traceEvents"] == []       # no lane, but no failure
+    assert 'instance="p"' in fc.prometheus()
+
+
+def test_relabel_handles_brace_inside_quoted_label_value():
+    """'}' inside a quoted label value is legal exposition text; the sample
+    must still get the instance label (an unlabeled duplicate across two
+    peers would break the merged OpenMetrics doc)."""
+    from deeplearning4j_tpu.telemetry.fleet import _relabel_prometheus
+    out = _relabel_prometheus(
+        'hits_total{route="/a}b",code="200"} 7\n'
+        'esc_total{v="q\\"}x"} 1\n'
+        "plain_total 2\n", "h0")
+    assert out[0] == 'hits_total{instance="h0",route="/a}b",code="200"} 7'
+    assert out[1] == 'esc_total{instance="h0",v="q\\"}x"} 1'
+    assert out[2] == 'plain_total{instance="h0"} 2'
+
+
+# ------------------------------------------------------ streaming context
+
+def test_broker_messages_carry_trace_context():
+    from deeplearning4j_tpu.streaming import BrokerClient, MessageBroker
+    broker = MessageBroker(port=0, registry=MetricsRegistry()).start()
+    client = BrokerClient(port=broker.port)
+    try:
+        t = Tracer(enabled=True)
+        with t.span("producer") as s:
+            client.publish("topic", {"kind": "registry_change", "v": 2})
+        got = client.poll("topic", timeout=5)
+        assert got["kind"] == "registry_change"
+        ctx = extract_message(got)
+        assert ctx is not None and ctx.trace_id == s.trace_id
+        # un-traced publishes stay untouched
+        client.publish("topic", {"kind": "plain"})
+        assert extract_message(client.poll("topic", timeout=5)) is None
+    finally:
+        client.close()
+        broker.stop()
+
+
+def test_serve_route_links_inputs_and_propagates_context():
+    from deeplearning4j_tpu.streaming import (NDArrayMessage, QueueSink,
+                                              QueueSource, ServeRoute)
+    from deeplearning4j_tpu.telemetry.trace import set_tracer
+    old = get_tracer()
+    tracer = set_tracer(Tracer(enabled=True))
+    try:
+        src, sink = QueueSource(), QueueSink()
+        with tracer.span("origin") as origin:
+            header = format_traceparent(origin)
+        src.put(NDArrayMessage(np.ones((1, 4), np.float32),
+                               traceparent=header))
+        route = ServeRoute(StubModel(), src, sink, poll_timeout=0.01).start()
+        try:
+            for _ in range(500):
+                if sink.messages:
+                    break
+                import time
+                time.sleep(0.01)
+            assert sink.messages, "route produced nothing"
+        finally:
+            route.stop()
+        # the prediction message still carries the ORIGIN's context
+        assert sink.messages[0].traceparent == header
+        assert sink.messages[0].trace_context().trace_id == origin.trace_id
+        dispatch = [s for s in tracer.finished_spans()
+                    if s.name == "route_dispatch"]
+        assert dispatch and dispatch[0].links[0]["trace_id"] == origin.trace_id
+    finally:
+        set_tracer(old)
+
+
+# ------------------------------------------------------------- acceptance
+
+def test_acceptance_fleet_trace_exemplar_logs_loop():
+    """ISSUE 7 acceptance: client post_json -> /predict -> batcher dispatch
+    is ONE trace_id spanning client and server spans, with the request span
+    linked to its batch span; /fleet/trace over two live servers renders
+    both hosts in distinct pid lanes; a firing alert's payload carries an
+    exemplar trace_id whose spans and /logs records are retrievable."""
+    from deeplearning4j_tpu.serving import ServingServer
+    fired = []
+    s1 = ServingServer(StubModel(), port=0, alert_interval_s=0,
+                       alert_rules=[AlertRule(
+                           "latency_always", metric="latency_ms",
+                           percentile=0.5, threshold=0.0, op=">")],
+                       alert_sinks=[fired.append]).start()
+    s2 = ServingServer(StubModel(), port=0).start()
+    fleet = FleetServer([s1.url, s2.url], names=["host-a", "host-b"],
+                        interval_s=0.0).start()
+    client = Tracer(enabled=True)
+    try:
+        with client.span("client_call") as cs:
+            res = post_json(s1.url + "/predict",
+                            {"data": [[1.0, 2.0, 3.0]]}, timeout=30)
+            client_trace = cs.trace_id
+        assert res["prediction"] == [[2.0, 4.0, 6.0]]
+        post_json(s2.url + "/predict", {"data": [[1.0]]}, timeout=30)
+
+        # --- ONE trace across client and server ---------------------------
+        trace = get_json(s1.url + "/trace", timeout=30)
+        spans = [e for e in trace["traceEvents"] if e.get("ph") == "X"]
+        mine = [e for e in spans
+                if e["args"].get("trace_id") == client_trace]
+        names = {e["name"] for e in mine}
+        assert {"http /predict", "predict", "admission"} <= names, names
+        # the request span links to the exact batch that served it
+        admission = next(e for e in mine if e["name"] == "admission")
+        batch = next(e for e in spans if e["name"] == "batch")
+        assert admission["args"]["batch_span_id"] == batch["args"]["span_id"]
+        assert {"trace_id": client_trace,
+                "span_id": admission["args"]["span_id"]} not in \
+            [{"trace_id": batch["args"]["trace_id"],
+              "span_id": batch["args"]["span_id"]}]  # distinct traces
+        flows = [e for e in trace["traceEvents"] if e.get("cat") == "link"]
+        assert flows, "request<->batch links must export as flow events"
+
+        # --- firing alert carries a retrievable exemplar ------------------
+        s1.alerts.evaluate()
+        firing = [ev for ev in fired if ev["state"] == "firing"]
+        assert firing, fired
+        exemplars = firing[0]["exemplars"]
+        assert exemplars and exemplars[-1]["trace_id"] == client_trace
+        ex_trace = exemplars[-1]["trace_id"]
+        # exemplar -> spans
+        assert any(e["args"].get("trace_id") == ex_trace for e in spans)
+        # exemplar -> correlated /logs records (three-click loop closes)
+        logs = get_json(s1.url + f"/logs?trace_id={ex_trace}", timeout=30)
+        assert logs["records"] and \
+            logs["records"][-1]["message"] == "predict_ok"
+        # the exemplar also rides the prometheus exposition
+        text = get_json(s1.url + "/metrics?format=prometheus", timeout=30)
+        assert f'trace_id="{ex_trace}"' in text
+
+        # --- fleet plane over two live hosts ------------------------------
+        ftrace = get_json(fleet.url + "/fleet/trace", timeout=30)
+        lanes = {e["pid"] for e in ftrace["traceEvents"]}
+        assert lanes == {0, 1}
+        lane_names = {e["args"]["name"] for e in ftrace["traceEvents"]
+                      if e["ph"] == "M"}
+        assert lane_names == {"host-a", "host-b"}
+        # the client trace is visible in the fleet-merged view too
+        assert any(e.get("args", {}).get("trace_id") == client_trace
+                   for e in ftrace["traceEvents"])
+        status, fh = get_json(fleet.url + "/fleet/healthz", timeout=30,
+                              with_status=True)
+        assert status == 200 and fh["status"] == "healthy"
+        fm = get_json(fleet.url + "/fleet/metrics", timeout=30)
+        assert fm["totals"]["requests"] == 2
+        assert set(fm["instances"]) == {"host-a", "host-b"}
+        fa = get_json(fleet.url + "/fleet/alerts", timeout=30)
+        assert any(r["state"] == "firing" and r["instance"] == "host-a"
+                   for r in fa["rules"])
+        ftext = get_json(fleet.url + "/fleet/metrics?format=prometheus",
+                         timeout=30)
+        assert 'instance="host-a"' in ftext and 'instance="host-b"' in ftext
+    finally:
+        fleet.stop()
+        s1.stop()
+        s2.stop()
+
+
+def test_smoke_fleet_tool():
+    """Fast variant of tools/smoke_fleet.py: the whole propagation ->
+    exemplar -> fleet loop in one run."""
+    import tools.smoke_fleet as smoke
+    out = smoke.run(n_requests=6)
+    assert out["fleet_instances_up"] == 2
+    assert out["fleet_lanes"] == [0, 1]
+    assert out["span_link_flows"] > 0
+    assert out["exemplar_log_records"] > 0

@@ -254,3 +254,43 @@ def test_sharded_trainer_grouped_padding_falls_back():
                                sharded.get_flat_params(),
                                rtol=1e-5, atol=1e-6)
     assert sharded.examples_fit == 32 * 4 + 27
+
+
+def test_lstm_tbptt_carry_donation_no_warnings_both_paths():
+    """ISSUE-7 satellite: the char_rnn/LSTM TBPTT carries must donate
+    cleanly on BOTH training paths — the scanned multi_tbptt executable
+    (fixed in PR 6: final carries are scan outputs) and the per-window
+    fit_batch path (carries are donate_argnums=8 of the tbptt train step).
+    JAX computes donation aliasing platform-independently at lowering, so
+    this CPU test catches a donated-but-unusable carry buffer exactly like
+    the TPU run that put "Some donated buffers were not usable:
+    float32[64,256] x4" in BENCH_r05's tail; bench.py now also counts the
+    warning across every workload (donation_warnings)."""
+    import warnings
+    from deeplearning4j_tpu.zoo.models import char_rnn_lstm
+
+    def mk():
+        net = char_rnn_lstm(vocab_size=12, hidden=16, layers=2, tbptt=5)
+        return net.init()
+
+    rng = np.random.default_rng(0)
+    ids = rng.integers(0, 12, size=(4, 21))
+    x = np.eye(12, dtype=np.float32)[ids[:, :-1]]
+    y = np.eye(12, dtype=np.float32)[ids[:, 1:]]
+    ds = DataSet(jnp.asarray(x), jnp.asarray(y))
+    with warnings.catch_warnings(record=True) as caught:
+        warnings.simplefilter("always")
+        per_window = mk()
+        per_window.fit_batch(ds)             # per-window tbptt train step
+        per_window.fit_batch(ds)
+        scanned = mk()
+        plan = scanned.prepare_steps([ds] * 2)
+        assert plan is not None and plan[0] == "tbptt"
+        scanned.fit_prepared(plan)           # scanned multi_tbptt executable
+        scanned.fit_prepared(plan)
+    donation = [str(w.message) for w in caught
+                if "donated buffers were not usable" in str(w.message)]
+    assert donation == [], donation
+    # both paths still train to finite scores
+    assert np.isfinite(float(per_window.score_value))
+    assert np.isfinite(float(scanned.score_value))

@@ -169,13 +169,15 @@ def test_gl002_payload_module_and_dataflow_triggers():
     assert [v.line for v in lint(src, rel_path="deeplearning4j_tpu/ui/stats.py")] \
         == [4]
     assert lint(src) == []   # same code elsewhere: no HTTP evidence, quiet
-    # dumps flowing into an HTTP request body through an assignment
+    # dumps flowing into an HTTP request body through an assignment (the
+    # raw urllib client itself now also trips GL008)
     flow = ("import json\n"
             "import urllib.request\n\n"
             "def post(url, d):\n"
             "    body = json.dumps(d).encode()\n"
             "    return urllib.request.Request(url, data=body)\n")
-    assert [(v.rule, v.line) for v in lint(flow)] == [("GL002", 5)]
+    assert [(v.rule, v.line) for v in lint(flow)] == [("GL002", 5),
+                                                      ("GL008", 6)]
     # dumps written straight to a handler's wfile
     wf = ("import json\n\n"
           "class H:\n"
@@ -352,6 +354,57 @@ def test_gl007_prefetcher_put_path_is_narrow():
     assert report.violations == [] and report.errors == []
 
 
+def test_gl008_raw_http_client_forms_and_allowlist():
+    # every urllib.request / http.client call form fires, plain or aliased
+    seeded = ("""\
+import urllib.request
+import http.client
+from urllib.request import urlopen as uo
+
+def fetch(url):
+    req = urllib.request.Request(url)
+    with urllib.request.urlopen(req, timeout=5) as r:
+        a = r.read()
+    b = uo(url).read()
+    conn = http.client.HTTPConnection("h")
+    return a, b, conn
+""")
+    vs = lint(seeded, rules=["GL008"])
+    assert [(v.rule, v.line) for v in vs] == [("GL008", n)
+                                             for n in (6, 7, 9, 10)]
+    # util/http.py is the one allowlisted module (the choke point itself)
+    assert lint(seeded, rel_path="deeplearning4j_tpu/util/http.py",
+                rules=["GL008"]) == []
+    # non-socket urllib members stay quiet: parse helpers, error types,
+    # and unresolvable local names
+    quiet = ("""\
+from urllib.parse import urlparse
+import urllib.error
+
+def ok(url, client):
+    u = urlparse(url)
+    try:
+        return client.urlopen(url)
+    except urllib.error.HTTPError as e:
+        return e.code
+""")
+    assert lint(quiet, rules=["GL008"]) == []
+
+
+def test_gl008_repo_choke_point_holds():
+    """Satellite gate: outbound HTTP in the package goes through
+    util.http.post_json/get_json — the propagation choke point. The single
+    deliberate remainder (dataset artifact download) is baselined with a
+    note; nothing else may join it silently."""
+    report = Analyzer(rules=[get_rule("GL008")], root=str(REPO)).analyze_paths(
+        ["deeplearning4j_tpu", "tools"])
+    assert report.errors == []
+    new, matched = Baseline.load(str(BASELINE_PATH)).split(report.violations)
+    assert new == []
+    assert [v.path for v in matched] == \
+        ["deeplearning4j_tpu/datasets/fetchers/download.py"]
+
+
 # ---------------------------------------------------------------- baseline
 
 def test_baseline_round_trip_via_cli(tmp_path):
@@ -481,7 +534,8 @@ def test_cli_rule_subset_and_list_rules():
     for rule in all_rules():
         assert rule.id in proc.stdout and rule.rationale
     assert [r.id for r in all_rules()] == \
-        ["GL001", "GL002", "GL003", "GL004", "GL005", "GL006", "GL007"]
+        ["GL001", "GL002", "GL003", "GL004", "GL005", "GL006", "GL007",
+         "GL008"]
 
 
 def test_repo_gate_is_clean_and_fast():

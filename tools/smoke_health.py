@@ -22,8 +22,6 @@ from __future__ import annotations
 import json
 import sys
 import tempfile
-import urllib.error
-import urllib.request
 from pathlib import Path
 
 sys.path.insert(0, str(Path(__file__).resolve().parents[1]))
@@ -31,11 +29,8 @@ sys.path.insert(0, str(Path(__file__).resolve().parents[1]))
 
 def _get(url, timeout=30):
     """(status, decoded-JSON body) — 4xx/5xx answers return, not raise."""
-    try:
-        with urllib.request.urlopen(url, timeout=timeout) as r:
-            return r.status, json.loads(r.read())
-    except urllib.error.HTTPError as e:
-        return e.code, json.loads(e.read())
+    from deeplearning4j_tpu.util.http import get_json
+    return get_json(url, timeout=timeout, with_status=True)
 
 
 def run(nin=6, n_batches=4, seed=0):

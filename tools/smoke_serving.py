@@ -25,6 +25,10 @@ sys.path.insert(0, str(Path(__file__).resolve().parents[1]))
 # graftlint: disable-file=GL001 — this benchmark measures REAL wall-clock
 # latency of live HTTP calls; reading an injectable time source here would
 # zero every measurement under a test-installed ManualClock
+# graftlint: disable-file=GL008 — the hot loop times pre-encoded payload
+# bytes through a raw urllib request on purpose: util.http.post_json would
+# re-serialize the body inside the timed region and skew every latency
+# number; nothing here needs trace propagation
 
 
 def run(n_requests=200, concurrency=16, max_rows=4, p99_budget_ms=10000.0,

@@ -1,11 +1,13 @@
 """Telemetry smoke test: serve N requests through a live ServingServer,
 then assert (a) a non-empty Prometheus scrape with the core serving series
-and (b) a valid Chrome-trace JSON export containing the nested
-predict -> admission/batch -> dispatch span tree.
+and (b) a valid Chrome-trace JSON export containing the
+predict -> admission span trees plus batch spans LINKED (flow events) to
+the requests they coalesced.
 
-This drives the whole observability path end to end: handler root span ->
-trace context propagated through the admission queue -> batcher
-batch/dispatch spans -> compile accounting -> registry -> exposition.
+This drives the whole observability path end to end: client traceparent
+injected by util.http.post_json -> handler server span -> trace context
+propagated through the admission queue -> batcher batch/dispatch spans +
+span links -> compile accounting -> registry -> exposition.
 
 Usage:
     JAX_PLATFORMS=cpu python tools/smoke_telemetry.py [-n 32] [-c 8]
@@ -15,13 +17,12 @@ from __future__ import annotations
 import argparse
 import json
 import sys
-import urllib.request
 from concurrent.futures import ThreadPoolExecutor
 from pathlib import Path
 
 sys.path.insert(0, str(Path(__file__).resolve().parents[1]))
 
-from deeplearning4j_tpu.util.http import dumps_safe  # noqa: E402
+from deeplearning4j_tpu.util.http import get_json, post_json  # noqa: E402
 
 REQUIRED_SERIES = ("requests_total", "latency_ms_bucket", "latency_ms_count",
                    "compiles_total", "queue_depth", "batches_total")
@@ -42,12 +43,14 @@ def _tiny_net(nin=6, nout=3, seed=0):
 
 
 def span_tree_depth(trace):
-    """Longest parent chain among the exported spans (1 = flat)."""
-    by_id = {e["args"]["span_id"]: e for e in trace["traceEvents"]}
+    """Longest parent chain among the exported spans (1 = flat). Only the
+    complete ("X") span events count — flow events carry no parent chain."""
+    spans = [e for e in trace["traceEvents"] if e.get("ph") == "X"]
+    by_id = {e["args"]["span_id"]: e for e in spans}
     best = 0
-    for e in trace["traceEvents"]:
+    for e in spans:
         depth, cur = 1, e
-        while cur["args"]["parent_id"] in by_id:
+        while cur["args"].get("parent_id") in by_id:
             cur = by_id[cur["args"]["parent_id"]]
             depth += 1
         best = max(best, depth)
@@ -66,24 +69,18 @@ def run(n_requests=32, concurrency=8, nin=6, seed=0):
         def fire(i):
             rows = int(rng.integers(1, 5))
             x = rng.normal(size=(rows, nin)).astype(np.float32)
-            req = urllib.request.Request(
-                server.url + "/predict",
-                data=dumps_safe({"data": x.tolist()}).encode(),
-                headers={"Content-Type": "application/json"})
-            with urllib.request.urlopen(req, timeout=60) as r:
-                out = json.loads(r.read())
+            out = post_json(server.url + "/predict",
+                            {"data": x.tolist()}, timeout=60)
             assert len(out["prediction"]) == rows, out["shape"]
 
         with ThreadPoolExecutor(max_workers=concurrency) as pool:
             list(pool.map(fire, range(n_requests)))
 
         # ---- Prometheus scrape ------------------------------------------
-        with urllib.request.urlopen(
-                server.url + "/metrics?format=prometheus", timeout=30) as r:
-            ctype = r.headers.get("Content-Type", "")
-            text = r.read().decode()
-        assert text.strip(), "empty prometheus scrape"
-        assert ctype.startswith("text/plain"), ctype
+        text = get_json(server.url + "/metrics?format=prometheus",
+                        timeout=30)
+        assert isinstance(text, str) and text.strip(), \
+            "empty prometheus scrape"
         missing = [s for s in REQUIRED_SERIES if s not in text]
         assert not missing, f"missing series: {missing}"
         req_line = next(l for l in text.splitlines()
@@ -91,21 +88,22 @@ def run(n_requests=32, concurrency=8, nin=6, seed=0):
         assert float(req_line.split()[-1]) == n_requests, req_line
 
         # ---- Chrome-trace export ----------------------------------------
-        with urllib.request.urlopen(server.url + "/trace", timeout=30) as r:
-            trace = json.loads(r.read())   # must be valid JSON
+        trace = get_json(server.url + "/trace", timeout=30)
         names = {e["name"] for e in trace["traceEvents"]}
         for want in ("predict", "admission", "batch", "dispatch"):
             assert want in names, f"missing span {want!r} in {sorted(names)}"
         depth = span_tree_depth(trace)
-        assert depth >= 3, f"span tree depth {depth} < 3"
+        assert depth >= 2, f"span tree depth {depth} < 2"
+        flows = [e for e in trace["traceEvents"] if e.get("cat") == "link"]
+        assert flows, "no request<->batch span-link flow events exported"
 
-        snap_req = urllib.request.urlopen(server.url + "/metrics", timeout=30)
-        snapshot = json.loads(snap_req.read())
+        snapshot = get_json(server.url + "/metrics", timeout=30)
         return {"requests": snapshot["requests"],
                 "compiles": snapshot.get("compiles", 0),
                 "p99_ms": snapshot["latency_ms"]["p99"],
                 "spans": len(trace["traceEvents"]),
                 "span_tree_depth": depth,
+                "span_link_flows": len(flows),
                 "scrape_bytes": len(text)}
     finally:
         server.stop()
