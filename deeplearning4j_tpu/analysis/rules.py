@@ -1,4 +1,4 @@
-"""GL001–GL008: the rule catalog (see RULES.md for the bug-history rationale).
+"""GL001–GL009: the rule catalog (see RULES.md for the bug-history rationale).
 
 Each rule is intra-file AST analysis with light import resolution: aliases
 from ``import x as y`` / ``from m import n as y`` are resolved so
@@ -645,3 +645,62 @@ class RawHttpClientRule(Rule):
                     f"injecting client choke point; use util.http.post_json/"
                     f"get_json (or baseline a deliberate raw client with a "
                     f"note)")
+
+
+# ---------------------------------------------------------------------------
+# GL009 — raw-retry-loop
+# ---------------------------------------------------------------------------
+
+@register
+class RawRetryLoopRule(Rule):
+    """Ad-hoc for/while retry loops with in-loop sleeps outside resilience/."""
+
+    id = "GL009"
+    name = "raw-retry-loop"
+    rationale = (
+        "A hand-rolled `for attempt in range(n): try ... except: "
+        "time.sleep(...)` loop has no jitter (retries synchronize into "
+        "thundering herds), no retry budget (a fleet-wide outage is "
+        "amplified by the retry factor), no deadline (the caller waits the "
+        "full worst case), and its own bespoke backoff constants. "
+        "resilience.RetryPolicy is the one implementation with all four; "
+        "the repo had grown three divergent copies of this loop before it "
+        "existed. Sleeps that merely pace a loop (no except handler) are "
+        "not retries and stay quiet.")
+
+    # the policy implementation itself (and its chaos harness) may sleep
+    ALLOW_DIR = "deeplearning4j_tpu/resilience/"
+
+    def check(self, ctx):
+        if ctx.rel_path.startswith(self.ALLOW_DIR):
+            return
+        aliases = ctx.aliases
+        for node in ctx.nodes:
+            if call_qual(node, aliases) != "time.sleep":
+                continue
+            if self._sleep_in_loop_handler(ctx, node):
+                yield self.violation(
+                    ctx, node,
+                    "sleep inside an except handler inside a loop — a "
+                    "hand-rolled retry; use resilience.RetryPolicy "
+                    "(backoff + jitter + budget + deadline) instead")
+
+    @staticmethod
+    def _sleep_in_loop_handler(ctx, node):
+        """The retry tell: the sleep sits INSIDE an except handler that is
+        itself inside a for/while in the same function — the shape of all
+        three hand-rolled loops this rule was derived from. A pacing sleep
+        in a loop that merely CONTAINS an unrelated try/except (queue
+        pollers draining with `except Empty: pass`, loops defining
+        callbacks with their own handlers) stays quiet. A def/lambda
+        boundary stops the search, like GL006/GL007."""
+        handler = False
+        for anc in ctx.ancestors(node):
+            if isinstance(anc, ast.ExceptHandler):
+                handler = True
+            elif isinstance(anc, (ast.While, ast.For)):
+                return handler
+            elif isinstance(anc, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                  ast.Lambda)):
+                return False
+        return False

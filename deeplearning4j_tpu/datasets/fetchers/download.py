@@ -14,9 +14,10 @@ import hashlib
 import os
 import shutil
 import tarfile
-import time
 import urllib.request
 import zipfile
+
+from ...resilience.policy import RetryPolicy
 
 DEFAULT_CACHE = os.path.join(os.path.expanduser("~"), ".deeplearning4j_tpu",
                              "data")
@@ -30,32 +31,44 @@ def _md5(path):
     return h.hexdigest()
 
 
-def download_file(url, dest, md5=None, max_tries=3, backoff_s=1.0):
+def download_file(url, dest, md5=None, max_tries=3, backoff_s=1.0,
+                  timeout_s=60.0):
     """Fetch url -> dest with bounded retries and optional md5 validation
     (reference: MnistFetcher.downloadAndUntar retry loop :103-107). Returns
-    dest; raises after max_tries failures. An existing file with a matching
-    checksum (or any existing file when no checksum is given) is reused."""
+    dest; raises after max_tries failures (the last underlying error is
+    chained). An existing file with a matching checksum (or any existing
+    file when no checksum is given) is reused. `timeout_s` bounds every
+    socket wait — a stalled mirror must not hang the fetch forever."""
     dest = str(dest)
     if os.path.exists(dest) and (md5 is None or _md5(dest) == md5):
         return dest
     os.makedirs(os.path.dirname(os.path.abspath(dest)), exist_ok=True)
-    last = None
-    for attempt in range(max_tries):
+
+    def attempt():
         tmp = dest + ".part"
         try:
-            with urllib.request.urlopen(url) as r, open(tmp, "wb") as f:
+            with urllib.request.urlopen(url, timeout=timeout_s) as r, \
+                    open(tmp, "wb") as f:
                 shutil.copyfileobj(r, f)
             if md5 is not None and _md5(tmp) != md5:
                 raise IOError(f"checksum mismatch for {url}")
             os.replace(tmp, dest)
             return dest
-        except Exception as e:
-            last = e
+        except Exception:
             if os.path.exists(tmp):
                 os.remove(tmp)
-            if attempt + 1 < max_tries:
-                time.sleep(backoff_s * (attempt + 1))
-    raise IOError(f"failed to download {url} after {max_tries} tries: {last}")
+            raise
+
+    # jittered exponential backoff between attempts, any failure retryable
+    # (checksum mismatches included, like the reference's loop)
+    policy = RetryPolicy(max_attempts=max_tries, base_s=backoff_s,
+                         cap_s=backoff_s * max_tries,
+                         retry_on=lambda e: True)
+    try:
+        return policy.call(attempt)
+    except Exception as last:
+        raise IOError(f"failed to download {url} after {max_tries} "
+                      f"tries: {last}") from last
 
 
 def extract(archive, out_dir):

@@ -1,0 +1,28 @@
+"""Resilience layer: the react half of observe -> detect -> react for
+individual hops and whole fleets.
+
+- `policy` — `RetryPolicy` (exponential backoff + full jitter, retry
+  budgets, total deadlines), `CircuitBreaker` (closed -> open -> half-open
+  over a rolling failure window), and thread-propagated `Deadline`s. Wired
+  into `util.http.post_json/get_json` (`retry=`/`breaker=`), the one
+  outbound client every hop already uses (graftlint GL008).
+- `chaos` — `FaultPlan`/`FaultRule` deterministic fault injection (latency,
+  5xx, connection reset, wedged socket, unhealthy health probes) installed
+  into that same choke point: kill/recover scripts with seeded RNG and an
+  injected clock, zero real sleeps.
+
+The fleet-facing consumers live in `serving/`: `FleetFrontend` (health-aware
+routing, per-replica breakers, single-failover retry) and `CanaryController`
+(alert-gated canary deploys).
+"""
+from .chaos import KINDS, FaultPlan, FaultRule
+from .policy import (CLOSED, HALF_OPEN, OPEN, STATE_CODES, CircuitBreaker,
+                     CircuitOpenError, Deadline, DeadlineExceededError,
+                     RetryBudget, RetryPolicy, current_deadline, deadline,
+                     guarded_call, is_retryable, is_server_fault)
+
+__all__ = ["KINDS", "FaultPlan", "FaultRule",
+           "CLOSED", "HALF_OPEN", "OPEN", "STATE_CODES", "CircuitBreaker",
+           "CircuitOpenError", "Deadline", "DeadlineExceededError",
+           "RetryBudget", "RetryPolicy", "current_deadline", "deadline",
+           "guarded_call", "is_retryable", "is_server_fault"]

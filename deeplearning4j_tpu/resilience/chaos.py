@@ -1,0 +1,233 @@
+"""Deterministic fault injection for the resilience layer's acceptance
+tests — chaos testing with zero real sleeps and a seeded RNG.
+
+A `FaultPlan` is an ordered list of `FaultRule`s installed into the ONE
+outbound HTTP choke point (`util.http` — graftlint GL008 guarantees every
+cross-process hop goes through it), so "replica B dies mid-traffic" is one
+rule, not a monkeypatch per call site. Each rule matches requests by method
+and URL substring and injects one failure mode:
+
+- ``latency``    — advance the injected clock by `latency_s` (a ManualClock
+                   advances; a real clock sleeps), then pass through: the
+                   request still succeeds, but every deadline/latency
+                   measurement sees the delay.
+- ``error``      — a canned HTTP `status` (default 500) with `body`.
+- ``reset``      — ConnectionResetError before any bytes move (the killed
+                   replica / dropped connection).
+- ``wedge``      — the wedged socket: the full client `timeout` elapses on
+                   the injected clock, then TimeoutError raises — what a
+                   black-holed peer costs the caller, without the wait.
+- ``unhealthy``  — a canned deep-health 503 (`{"health": "unhealthy", ...}`)
+                   so health-aware routers eject the replica.
+
+Rules fire deterministically: `after` skips the first N matches, `count`
+bounds total injections, `probability` draws from the plan's seeded RNG.
+Rules are JSON-round-trippable (`FaultPlan.to_json/from_json` — the shape is
+documented in README "Resilience & chaos testing") and can be toggled live
+(`set_active`) to script kill -> recover sequences.
+
+    plan = FaultPlan([FaultRule("reset", match=replica_b.url,
+                                name="kill-b")])
+    with plan:                       # installs into util.http
+        ... traffic; replica B is "dead" ...
+        plan.set_active("kill-b", False)   # B "recovers"
+"""
+from __future__ import annotations
+
+import random
+import threading
+
+from .policy import advance_aware_sleep
+
+KINDS = ("latency", "error", "reset", "wedge", "unhealthy")
+
+_UNHEALTHY_BODY = {"status": "unhealthy", "health": "unhealthy",
+                   "components": {"chaos": {"status": "unhealthy",
+                                            "reason": "injected fault"}}}
+
+
+class FaultRule:
+    """One failure mode bound to a request matcher (see module docstring
+    for the kinds and the firing controls)."""
+
+    def __init__(self, kind, match="", method=None, status=500,
+                 latency_s=0.0, after=0, count=None, probability=1.0,
+                 body=None, name=None, active=True):
+        if kind not in KINDS:
+            raise ValueError(f"unknown fault kind {kind!r} (one of {KINDS})")
+        self.kind = str(kind)
+        self.match = str(match)
+        self.method = None if method is None else str(method).upper()
+        self.status = int(status)
+        self.latency_s = float(latency_s)
+        self.after = int(after)
+        self.count = None if count is None else int(count)
+        self.probability = float(probability)
+        self.body = body
+        self.name = str(name) if name is not None else self.kind
+        self.active = bool(active)
+        self.seen = 0            # matching requests observed
+        self.injected = 0        # faults actually fired
+
+    def matches(self, method, url) -> bool:
+        if not self.active:
+            return False
+        if self.method is not None and method != self.method:
+            return False
+        return self.match in url
+
+    # -- declarative round-trip ---------------------------------------------
+    def to_dict(self):
+        d = {"kind": self.kind, "match": self.match, "name": self.name}
+        if self.method is not None:
+            d["method"] = self.method
+        if self.kind == "error":
+            d["status"] = self.status
+        if self.kind == "latency":
+            d["latency_s"] = self.latency_s
+        if self.after:
+            d["after"] = self.after
+        if self.count is not None:
+            d["count"] = self.count
+        if self.probability != 1.0:
+            d["probability"] = self.probability
+        if self.body is not None:
+            d["body"] = self.body
+        if not self.active:
+            d["active"] = False
+        return d
+
+    @classmethod
+    def from_dict(cls, d):
+        d = dict(d)
+        return cls(d.pop("kind"), **d)
+
+
+class FaultPlan:
+    """An installable set of FaultRules. `install()`/`uninstall()` (or the
+    context manager) swap the plan into util.http's injector seam; multiple
+    matching rules compose (every matching `latency` adds its delay; the
+    first matching terminal kind — error/reset/wedge/unhealthy — wins)."""
+
+    def __init__(self, rules=(), seed=0):
+        self.rules = [r if isinstance(r, FaultRule) else FaultRule.from_dict(r)
+                      for r in rules]
+        self._rng = random.Random(seed)
+        self._lock = threading.Lock()
+        self._prev = None
+        self._installed = False
+
+    # -- lifecycle ------------------------------------------------------------
+    def install(self):
+        from ..util import http
+        if not self._installed:
+            self._prev = http.set_fault_injector(self.intercept)
+            self._installed = True
+        return self
+
+    def uninstall(self):
+        from ..util import http
+        if self._installed:
+            http.set_fault_injector(self._prev)
+            self._prev = None
+            self._installed = False
+        return self
+
+    def __enter__(self):
+        return self.install()
+
+    def __exit__(self, *exc):
+        self.uninstall()
+        return False
+
+    # -- scripting ------------------------------------------------------------
+    def add(self, rule):
+        if not isinstance(rule, FaultRule):
+            rule = FaultRule.from_dict(rule)
+        with self._lock:
+            self.rules.append(rule)
+        return rule
+
+    def set_active(self, name, active=True):
+        """Toggle every rule named `name`; returns how many matched — the
+        kill/recover switch chaos scripts flip mid-traffic."""
+        n = 0
+        with self._lock:
+            for r in self.rules:
+                if r.name == name:
+                    r.active = bool(active)
+                    n += 1
+        if n == 0:
+            raise KeyError(f"no fault rule named {name!r}")
+        return n
+
+    def injected(self):
+        """{rule name: injections so far} — assertable chaos accounting."""
+        with self._lock:
+            out = {}
+            for r in self.rules:
+                out[r.name] = out.get(r.name, 0) + r.injected
+            return out
+
+    def to_json(self):
+        return [r.to_dict() for r in self.rules]
+
+    @classmethod
+    def from_json(cls, rules, seed=0):
+        return cls(rules, seed=seed)
+
+    # -- the injector ---------------------------------------------------------
+    @staticmethod
+    def _advance(seconds):
+        """Pass time deterministically (see policy.advance_aware_sleep)."""
+        advance_aware_sleep(seconds)
+
+    def _fire(self, rule):
+        """Should `rule` fire for this (already-matched) request?"""
+        rule.seen += 1
+        if rule.seen <= rule.after:
+            return False
+        if rule.count is not None and rule.injected >= rule.count:
+            return False
+        if rule.probability < 1.0 and \
+                self._rng.random() >= rule.probability:
+            return False
+        rule.injected += 1
+        return True
+
+    def intercept(self, method, url, timeout):
+        """util.http's injector protocol: return None to pass through,
+        return (status, body) for a canned response, or raise the injected
+        transport error. Rule selection happens under the plan lock, but
+        the time cost (latency advance, wedge wait) is paid OUTSIDE it —
+        a wedged replica must cost ITS caller the timeout, not serialize
+        every other outbound call in the process behind the lock."""
+        delay, terminal = 0.0, None
+        with self._lock:
+            for rule in self.rules:
+                if not rule.matches(method, url) or not self._fire(rule):
+                    continue
+                if rule.kind == "latency":
+                    delay += rule.latency_s
+                    continue             # non-terminal: keep matching
+                terminal = rule
+                break
+        if delay > 0.0:
+            self._advance(delay)
+        if terminal is None:
+            return None
+        if terminal.kind == "error":
+            return terminal.status, (terminal.body
+                                     if terminal.body is not None
+                                     else {"error": "injected fault",
+                                           "fault": terminal.name})
+        if terminal.kind == "unhealthy":
+            return 503, (terminal.body if terminal.body is not None
+                         else dict(_UNHEALTHY_BODY))
+        if terminal.kind == "reset":
+            raise ConnectionResetError(
+                f"chaos: injected connection reset ({terminal.name})")
+        # wedge: the full client timeout elapses, then the socket "dies"
+        self._advance(timeout or 0.0)
+        raise TimeoutError(f"chaos: wedged socket ({terminal.name}), "
+                           f"timed out after {timeout}s")

@@ -1,0 +1,502 @@
+"""Resilience policies: retry with backoff+jitter, circuit breaking, and
+deadline propagation — the react half of observe -> detect -> react for
+individual RPC hops.
+
+The fleet PRs made every cross-process hop observable (traceparent headers,
+exemplars, /fleet/*), but a flaky hop still surfaced as a raw exception at
+whatever layer happened to call it, and the repo grew three ad-hoc retry
+loops (broker reconnect, remote stats router, dataset download) with three
+different backoff conventions and zero budgets. This module is the single
+vocabulary:
+
+- `RetryPolicy` — bounded attempts with exponential backoff and full jitter
+  over `[base_s, min(cap_s, base_s * multiplier**attempt)]`, an optional
+  shared `RetryBudget` (token bucket: a storm of failures must not multiply
+  itself by the retry factor), and per-call total deadlines. On exhaustion
+  the *last underlying error* raises — never a synthetic "retries exceeded"
+  that hides the real failure. Each retry counts into
+  `retries_total{reason=<exc type>}`.
+- `CircuitBreaker` — closed -> open -> half-open. A rolling window of
+  outcomes opens the circuit when the failure ratio crosses the threshold
+  (with a minimum call count so one early failure can't trip it); after
+  `open_for_s` a bounded number of half-open probes are admitted: one
+  success re-closes, one failure re-opens.
+- `Deadline` — a monotonic budget that travels with the calling thread
+  (`with deadline(2.0): ...`): `util.http.post_json/get_json` clamp their
+  socket timeouts to the remaining budget and fail fast with
+  `DeadlineExceededError` once it is spent, so a chain of hops can never
+  outlive the caller's patience.
+
+Every clock read goes through `util.time_source` and the sleeper/RNG are
+injectable, so ManualClock tests drive whole retry storms and breaker
+lifecycles with zero real sleeps (`sleep=clock.advance`).
+"""
+from __future__ import annotations
+
+import http.client
+import random
+import threading
+import time
+import urllib.error
+
+from ..util.time_source import monotonic_s
+
+CLOSED, OPEN, HALF_OPEN = "closed", "open", "half_open"
+#: numeric encoding for the breaker-state gauge (alertable: state >= 2 = open)
+STATE_CODES = {CLOSED: 0, HALF_OPEN: 1, OPEN: 2}
+
+
+class DeadlineExceededError(TimeoutError):
+    """The caller's total budget is spent — retrying cannot help."""
+
+
+class CircuitOpenError(ConnectionError):
+    """The breaker is open: the call was rejected without touching the
+    network. Not retryable by default (failing fast IS the point); a router
+    treats it as "pick another replica"."""
+
+
+# ---------------------------------------------------------------------------
+# deadlines
+# ---------------------------------------------------------------------------
+
+_tls = threading.local()        # .deadlines: stack of active Deadline objects
+
+
+class Deadline:
+    """A total time budget anchored at construction. `timeout_s=None` means
+    unbounded (remaining() is None, never expires)."""
+
+    __slots__ = ("timeout_s", "_expires")
+
+    def __init__(self, timeout_s=None):
+        self.timeout_s = None if timeout_s is None else float(timeout_s)
+        self._expires = None if timeout_s is None \
+            else monotonic_s() + float(timeout_s)
+
+    def remaining(self):
+        """Seconds left (>= 0.0), or None when unbounded."""
+        if self._expires is None:
+            return None
+        return max(0.0, self._expires - monotonic_s())
+
+    @property
+    def expired(self):
+        return self._expires is not None and monotonic_s() >= self._expires
+
+    def clamp(self, timeout_s):
+        """`timeout_s` bounded by the remaining budget; raises
+        DeadlineExceededError when the budget is already spent (a call that
+        cannot finish in time must not start)."""
+        if self._expires is None:
+            return timeout_s
+        rem = self.remaining()
+        if rem <= 0.0:
+            raise DeadlineExceededError(
+                f"deadline of {self.timeout_s}s exhausted")
+        return rem if timeout_s is None else min(float(timeout_s), rem)
+
+    # -- thread-local propagation -------------------------------------------
+    def __enter__(self):
+        stack = getattr(_tls, "deadlines", None)
+        if stack is None:
+            stack = _tls.deadlines = []
+        if stack:
+            # nested budgets compose: an inner deadline may only SHRINK the
+            # window ("a hop may never outlive its caller's total budget"),
+            # so an inner RetryPolicy(total_timeout_s=60) cannot un-clamp
+            # socket timeouts past an enclosing `with deadline(0.5)`
+            outer = stack[-1]._expires
+            if outer is not None and \
+                    (self._expires is None or outer < self._expires):
+                self._expires = outer
+        stack.append(self)
+        return self
+
+    def __exit__(self, *exc):
+        _tls.deadlines.pop()
+        return False
+
+
+def deadline(timeout_s):
+    """`with deadline(2.0): post_json(...)` — every util.http call (and any
+    other current_deadline() reader) in the block shares one total budget."""
+    return Deadline(timeout_s)
+
+
+def current_deadline():
+    """Innermost active Deadline on this thread, or None."""
+    stack = getattr(_tls, "deadlines", None)
+    return stack[-1] if stack else None
+
+
+# ---------------------------------------------------------------------------
+# retryability classification
+# ---------------------------------------------------------------------------
+
+def is_retryable(exc) -> bool:
+    """Default classification: transport faults and server-side failures
+    retry; everything that proves the request itself is wrong does not.
+
+    - DeadlineExceededError / CircuitOpenError: never (the budget is spent /
+      the breaker wants the fast failure).
+    - HTTPError 5xx and 429: yes (the server answered "not now").
+    - other HTTPError (4xx): no (the request is at fault).
+    - any other OSError (connection refused/reset, socket timeout): yes.
+    - http.client.HTTPException (BadStatusLine, IncompleteRead — NOT
+      OSError subclasses): yes; a peer that corrupts the protocol
+      mid-response is as dead as one that reset the connection.
+    """
+    if isinstance(exc, (DeadlineExceededError, CircuitOpenError)):
+        return False
+    if isinstance(exc, urllib.error.HTTPError):
+        return exc.code >= 500 or exc.code == 429
+    return isinstance(exc, (OSError, http.client.HTTPException))
+
+
+def is_server_fault(exc) -> bool:
+    """Should this failure count against the TARGET's circuit breaker?
+    Like is_retryable, minus 429 (load shedding is the server protecting
+    itself by design, not the server being broken) and minus our own
+    deadline/breaker short-circuits."""
+    if isinstance(exc, (DeadlineExceededError, CircuitOpenError)):
+        return False
+    if isinstance(exc, urllib.error.HTTPError):
+        return exc.code >= 500
+    return isinstance(exc, (OSError, http.client.HTTPException))
+
+
+# ---------------------------------------------------------------------------
+# retry budget + policy
+# ---------------------------------------------------------------------------
+
+class RetryBudget:
+    """Token bucket shared across calls: each retry spends one token; tokens
+    refill at `refill_per_s` up to `capacity`. When the bucket is empty,
+    retries are denied (the last error raises immediately) — a fleet-wide
+    failure must not be amplified by the retry multiplier."""
+
+    def __init__(self, capacity=10.0, refill_per_s=0.5):
+        self.capacity = float(capacity)
+        self.refill_per_s = float(refill_per_s)
+        self._tokens = self.capacity
+        self._last = monotonic_s()
+        self._lock = threading.Lock()
+        self.denied = 0
+
+    def _refill(self):
+        now = monotonic_s()
+        self._tokens = min(self.capacity,
+                           self._tokens + (now - self._last)
+                           * self.refill_per_s)
+        self._last = now
+
+    def try_spend(self, n=1.0) -> bool:
+        with self._lock:
+            self._refill()
+            if self._tokens >= n:
+                self._tokens -= n
+                return True
+            self.denied += 1
+            return False
+
+    def tokens(self) -> float:
+        with self._lock:
+            self._refill()
+            return self._tokens
+
+
+def _default_sleep(seconds):
+    if seconds > 0:
+        time.sleep(seconds)
+
+
+def advance_aware_sleep(seconds):
+    """Pass time deterministically where possible: a ManualClock advances
+    (zero real sleep — chaos latency/wedge faults and the canary rollback
+    retry ride this), any other time source pays the real wait."""
+    if seconds <= 0:
+        return
+    from ..util.time_source import TimeSourceProvider
+    advance = getattr(TimeSourceProvider.get_instance(), "advance", None)
+    if advance is not None:
+        advance(seconds)
+    else:
+        _default_sleep(seconds)
+
+
+def count_retry(exc, registry=None):
+    """Count one retry into `retries_total{reason}` — THE series for every
+    resilience-issued retry, shared by RetryPolicy and the fleet
+    front-end's failover loop so the two cannot drift into same-named
+    counters with diverging help text. `registry=None` uses the
+    process-global one."""
+    if registry is None:
+        from ..telemetry.registry import get_registry
+        registry = get_registry()
+    try:
+        registry.counter(
+            "retries_total",
+            "Retries issued by resilience retry/failover paths, by "
+            "failure reason").inc(1, reason=type(exc).__name__)
+    except Exception:
+        pass                # metrics must never break the retried call
+
+
+class RetryPolicy:
+    """Bounded retry with exponential backoff + full jitter.
+
+    The delay before retry `n` (0-based) is drawn uniformly from
+    `[base_s, min(cap_s, base_s * multiplier**n)]` — jittered so a thundering
+    herd decorrelates, floored at base_s so a retry is never an immediate
+    hammer, capped so backoff can't grow unbounded.
+
+    `retry_on` is a predicate (default `is_retryable`) or a tuple of
+    exception types. `budget` (RetryBudget) and `total_timeout_s` bound the
+    damage; on any exhaustion (attempts, budget, deadline) the LAST
+    underlying error re-raises. `sleep` and `rng` are injectable for
+    deterministic tests (`sleep=manual_clock.advance`).
+    """
+
+    def __init__(self, max_attempts=3, base_s=0.1, cap_s=5.0, multiplier=2.0,
+                 retry_on=None, budget=None, total_timeout_s=None,
+                 rng=None, sleep=None, registry=None):
+        if max_attempts < 1:
+            raise ValueError("max_attempts must be >= 1")
+        self.max_attempts = int(max_attempts)
+        self.base_s = float(base_s)
+        self.cap_s = float(cap_s)
+        self.multiplier = float(multiplier)
+        if callable(retry_on):
+            self._retryable = retry_on
+        elif retry_on is not None:
+            types = tuple(retry_on)
+            self._retryable = lambda e: isinstance(e, types)
+        else:
+            self._retryable = is_retryable
+        self.budget = budget
+        self.total_timeout_s = total_timeout_s
+        self._rng = rng if rng is not None else random.Random()
+        self._sleep = sleep if sleep is not None else _default_sleep
+        self._registry = registry
+        self.attempts_made = 0          # cumulative, across calls
+
+    def backoff_s(self, attempt) -> float:
+        """Jittered delay before retry `attempt` (0-based), guaranteed
+        within [base_s, cap_s]."""
+        ceiling = min(self.cap_s,
+                      self.base_s * (self.multiplier ** attempt))
+        lo = min(self.base_s, ceiling)
+        return self._rng.uniform(lo, ceiling)
+
+    def _count_retry(self, exc):
+        count_retry(exc, registry=self._registry)
+
+    def call(self, fn, *args, **kwargs):
+        """Invoke `fn(*args, **kwargs)` under this policy; returns its result
+        or raises the last underlying error once retries are exhausted.
+
+        With `total_timeout_s` set the Deadline is ENTERED on the
+        thread-local stack, so util.http (and any other current_deadline()
+        reader) clamps the in-flight attempt's socket timeout too — the
+        budget bounds the whole chain, not just the backoff between
+        attempts."""
+        if self.total_timeout_s is not None:
+            with Deadline(self.total_timeout_s) as dl:
+                return self._run(fn, args, kwargs, dl)
+        return self._run(fn, args, kwargs, current_deadline())
+
+    def _run(self, fn, args, kwargs, dl):
+        last = None
+        for attempt in range(self.max_attempts):
+            self.attempts_made += 1
+            try:
+                return fn(*args, **kwargs)
+            except Exception as e:
+                last = e
+                if attempt + 1 >= self.max_attempts \
+                        or not self._retryable(e):
+                    raise
+                if dl is not None and dl.expired:
+                    raise
+                if self.budget is not None and not self.budget.try_spend():
+                    raise
+                delay = self.backoff_s(attempt)
+                if dl is not None:
+                    rem = dl.remaining()
+                    if rem is not None:
+                        if rem <= 0.0:
+                            raise
+                        delay = min(delay, rem)
+                self._count_retry(e)
+                self._sleep(delay)
+        raise last          # unreachable (loop always returns or raises)
+
+
+# ---------------------------------------------------------------------------
+# circuit breaker
+# ---------------------------------------------------------------------------
+
+class CircuitBreaker:
+    """closed -> open -> half-open circuit over a rolling outcome window.
+
+    While CLOSED every call is admitted and outcomes are recorded into a
+    bounded window; once at least `min_calls` outcomes are present and the
+    failure ratio reaches `failure_ratio`, the breaker OPENs: `allow()`
+    answers False (callers fail fast with CircuitOpenError, or route around)
+    until `open_for_s` has elapsed on the injected clock. Then HALF_OPEN
+    admits up to `half_open_max` concurrent probe calls: the first recorded
+    success re-closes (window reset), the first failure re-opens for another
+    `open_for_s`. All transitions go through `on_transition(breaker, old,
+    new)` when provided (the fleet front-end logs + counts them there).
+    """
+
+    def __init__(self, failure_ratio=0.5, window=20, min_calls=5,
+                 open_for_s=30.0, half_open_max=1, name="",
+                 on_transition=None):
+        self.failure_ratio = float(failure_ratio)
+        self.window = int(window)
+        self.min_calls = int(min_calls)
+        self.open_for_s = float(open_for_s)
+        self.half_open_max = int(half_open_max)
+        self.name = str(name)
+        self.on_transition = on_transition
+        self._lock = threading.Lock()
+        self._state = CLOSED
+        self._outcomes = []             # rolling [bool ok] window
+        self._opened_at = None          # monotonic_s of last open
+        self._probes = 0                # in-flight half-open probes
+        self.opens = 0                  # lifetime open transitions
+
+    # -- state ---------------------------------------------------------------
+    def _tick(self):
+        """OPEN -> HALF_OPEN once the cool-off elapsed (lock held)."""
+        if self._state == OPEN and \
+                monotonic_s() - self._opened_at >= self.open_for_s:
+            self._set_state(HALF_OPEN)
+            self._probes = 0
+
+    def _set_state(self, new):
+        old, self._state = self._state, new
+        if new == OPEN:
+            self.opens += 1
+            self._opened_at = monotonic_s()
+        if old != new and self.on_transition is not None:
+            try:
+                self.on_transition(self, old, new)
+            except Exception:
+                pass            # observers must never wedge the breaker
+
+    @property
+    def state(self) -> str:
+        with self._lock:
+            self._tick()
+            return self._state
+
+    @property
+    def state_code(self) -> int:
+        return STATE_CODES[self.state]
+
+    # -- protocol ------------------------------------------------------------
+    def allow(self) -> bool:
+        """May a call proceed right now? In HALF_OPEN this *claims* one of
+        the bounded probe slots — follow up with record_success/failure."""
+        with self._lock:
+            self._tick()
+            if self._state == CLOSED:
+                return True
+            if self._state == HALF_OPEN and self._probes < self.half_open_max:
+                self._probes += 1
+                return True
+            return False
+
+    def record_success(self):
+        with self._lock:
+            if self._state == HALF_OPEN:
+                # one healthy probe is proof enough: re-close, clean slate
+                self._outcomes = []
+                self._probes = 0
+                self._set_state(CLOSED)
+                return
+            self._record(True)
+
+    def release_probe(self):
+        """A half-open probe ended with no proof either way (e.g. the
+        CALLER'S deadline expired before the target answered): free the
+        slot so the next call may probe, without transitioning."""
+        with self._lock:
+            if self._state == HALF_OPEN and self._probes > 0:
+                self._probes -= 1
+
+    def record_failure(self):
+        with self._lock:
+            self._tick()
+            if self._state == HALF_OPEN:
+                self._probes = 0
+                self._set_state(OPEN)
+                return
+            if self._state == OPEN:     # late failure from an in-flight call
+                return
+            self._record(False)
+            n = len(self._outcomes)
+            if n >= self.min_calls:
+                failures = sum(1 for ok in self._outcomes if not ok)
+                if failures / n >= self.failure_ratio:
+                    self._set_state(OPEN)
+
+    def _record(self, ok):
+        self._outcomes.append(ok)
+        if len(self._outcomes) > self.window:
+            del self._outcomes[:len(self._outcomes) - self.window]
+
+    def to_dict(self):
+        with self._lock:
+            self._tick()
+            n = len(self._outcomes)
+            failures = sum(1 for ok in self._outcomes if not ok)
+            return {"name": self.name, "state": self._state,
+                    "state_code": STATE_CODES[self._state],
+                    "window_calls": n, "window_failures": failures,
+                    "opens": self.opens, "open_for_s": self.open_for_s}
+
+
+def record_outcome(breaker, exc):
+    """THE classification of one failed attempt for `breaker` (None-safe),
+    shared by guarded_call and the fleet front-end's attempt loop so the
+    two can never diverge: server faults (is_server_fault) count against
+    the target, a 4xx answer proves it alive, and a spent deadline proves
+    nothing either way (just free any half-open probe slot). A
+    CircuitOpenError was never admitted, so there is no outcome to
+    record."""
+    if breaker is None or isinstance(exc, CircuitOpenError):
+        return
+    if is_server_fault(exc):
+        breaker.record_failure()
+    elif isinstance(exc, DeadlineExceededError):
+        breaker.release_probe()
+    else:
+        breaker.record_success()           # the target answered (4xx)
+
+
+def guarded_call(fn, retry=None, breaker=None):
+    """Compose breaker + retry around a zero-arg callable — the glue
+    util.http uses for its `retry=`/`breaker=` parameters. The breaker sits
+    INSIDE the retry loop (each attempt consults it; an opened breaker makes
+    the remaining attempts fail fast), and only server faults
+    (is_server_fault) count against it."""
+    def attempt():
+        if breaker is not None and not breaker.allow():
+            raise CircuitOpenError(
+                f"circuit {breaker.name or 'breaker'} is {breaker.state}")
+        try:
+            result = fn()
+        except Exception as e:
+            record_outcome(breaker, e)
+            raise
+        if breaker is not None:
+            breaker.record_success()
+        return result
+
+    if retry is None:
+        return attempt()
+    return retry.call(attempt)

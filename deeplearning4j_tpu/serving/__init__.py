@@ -29,10 +29,14 @@ a thin compatibility wrapper over it.
 from .admission import (AdmissionQueue, DeadlineExceeded, RejectedError,
                         Request)
 from .batcher import DynamicBatcher, bucket_for
+from .canary import CanaryController
+from .frontend import FleetFrontend, RegistrySubscriber, ReplicaHandle
 from .metrics import ServingMetrics
 from .registry import ModelRegistry, ModelVersion, NoModelDeployed
 from .server import ServingServer
 
 __all__ = ["AdmissionQueue", "DeadlineExceeded", "RejectedError", "Request",
            "DynamicBatcher", "bucket_for", "ServingMetrics", "ModelRegistry",
-           "ModelVersion", "NoModelDeployed", "ServingServer"]
+           "ModelVersion", "NoModelDeployed", "ServingServer",
+           "FleetFrontend", "RegistrySubscriber", "ReplicaHandle",
+           "CanaryController"]

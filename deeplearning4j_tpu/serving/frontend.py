@@ -1,0 +1,664 @@
+"""FleetFrontend: health-aware HTTP router over N replica ServingServers.
+
+ROADMAP item 1's front-end half: one address in front of a serving fleet,
+closing observe -> detect -> REACT on replica failures. The PR-7 fleet plane
+could *see* a wedged replica (`/fleet/healthz`); this layer stops sending it
+user traffic:
+
+- **Health-aware pool.** Each replica's deep `/healthz` is polled on an
+  interval (clock-gated through util/time_source, so ManualClock tests drive
+  staleness with zero sleeps): healthy -> full routing weight, degraded ->
+  drained to half weight (still serving, visibly reduced), unhealthy/down ->
+  ejected (weight 0). `ModelRegistry.scan_errors` now surfaces as a degraded
+  registry probe on the replicas, so a half-broken persistent registry is
+  visible here too.
+- **Per-replica circuit breakers.** Connection resets / 5xx open the
+  replica's breaker (resilience.CircuitBreaker) even between health polls;
+  an open breaker routes around the replica, and the half-open probe
+  re-admits it after `breaker_open_for_s` — kill/recover needs no operator.
+  Breaker states export as the `breaker_state{replica=...}` gauge
+  (0 closed / 1 half-open / 2 open), so `/fleet/metrics` shows an ejection
+  as data, not absence.
+- **Single-failover retry.** A failed `/predict` attempt (reset, timeout,
+  5xx, 429, open breaker) fails over ONCE to a different replica — POST
+  /predict is idempotent by contract; non-idempotent routes (`/deploy`,
+  `/rollback`) are never retried. The whole request runs under one
+  resilience.Deadline, so the failover can't double the caller's worst-case
+  latency, and every attempt is a child span carrying `retry`/`failover`
+  attributes under the frontend's server span — the inbound `traceparent`
+  is preserved through util.http, so client -> frontend -> winning replica
+  is ONE trace in `/fleet/trace`.
+- **Registry fan-out.** Deploys/rollbacks routed through the frontend
+  publish registry-change events over the existing streaming broker
+  (`registry_events` topic); `RegistrySubscriber` lets any ServingServer
+  host (including ones behind *other* frontends) apply them against its own
+  `scan_dir` — the cross-host shared-registry view without a shared
+  database.
+- **Canary deploys.** `POST /deploy {"version": v, "canary": frac}` hands
+  off to `serving.canary.CanaryController` (alert-gated promote/rollback);
+  see that module.
+
+Endpoints: POST /predict /deploy /rollback; GET /healthz /metrics
+(?format=prometheus) /replicas /alerts /logs /trace.
+"""
+from __future__ import annotations
+
+import json
+import threading
+import urllib.error
+from urllib.parse import parse_qs, urlparse
+
+from ..resilience.policy import (CircuitBreaker, count_retry, Deadline,
+                                 DeadlineExceededError, OPEN,
+                                 is_retryable, record_outcome)
+from ..telemetry.alerts import AlertEngine
+from ..telemetry.health import (DEGRADED, HEALTHY, UNHEALTHY, HealthMonitor,
+                                _RANK)
+from ..telemetry.logging import StructuredLogger
+from ..telemetry.prometheus import CONTENT_TYPE as PROMETHEUS_CONTENT_TYPE
+from ..telemetry.registry import MetricsRegistry
+from ..telemetry.propagation import server_span
+from ..telemetry.trace import Tracer
+from ..util.http import (BackgroundHttpServer, QuietHandler, get_json,
+                         post_json)
+from ..util.time_source import monotonic_s
+
+STABLE, CANARY = "stable", "canary"
+DOWN = "down"
+_WEIGHTS = {HEALTHY: 1.0, DEGRADED: 0.5, UNHEALTHY: 0.0, DOWN: 0.0,
+            "unknown": 1.0}
+
+
+def _replica_name(url):
+    p = urlparse(url)
+    return p.netloc or url
+
+
+def _fan_out(targets, fn):
+    """Run `fn(target)` for every target, one daemon thread each (inline
+    for a single target): a wedged peer costs one timeout, not N. Shared
+    by the health sweep and the deploy/rollback broadcast; results travel
+    through fn's side effects (per-target attributes or dict slots)."""
+    targets = list(targets)
+    if len(targets) == 1:
+        fn(targets[0])
+        return
+    threads = [threading.Thread(target=fn, args=(t,), daemon=True)
+               for t in targets]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+
+
+class ReplicaHandle:
+    """One tracked replica: URL, last-known deep health, circuit breaker,
+    and canary/stable cohort membership."""
+
+    def __init__(self, name, url, breaker):
+        self.name = str(name)
+        self.url = str(url).rstrip("/")
+        self.breaker = breaker
+        self.cohort = STABLE
+        self.health = "unknown"        # healthy/degraded/unhealthy/down
+        self.health_detail = None      # last /healthz body (or error string)
+
+    def weight(self) -> float:
+        """Routing weight from last-known health; the breaker gates
+        separately (an open breaker routes around even a 'healthy' state)."""
+        return _WEIGHTS.get(self.health, 0.0)
+
+    def routable(self) -> bool:
+        return self.weight() > 0.0 and self.breaker.state != OPEN
+
+    def to_dict(self):
+        return {"name": self.name, "url": self.url, "cohort": self.cohort,
+                "health": self.health, "weight": self.weight(),
+                "routable": self.routable(),
+                "breaker": self.breaker.to_dict()}
+
+
+class FleetFrontend(BackgroundHttpServer):
+    """See module docstring. `replicas` is a list of ServingServer base
+    URLs; `names` optionally overrides the instance labels (default
+    host:port). `broker` (a streaming.BrokerClient) enables registry-event
+    fan-out on `broker_topic`."""
+
+    MAX_ATTEMPTS = 2       # initial try + single failover
+
+    def __init__(self, replicas, names=None, host="127.0.0.1", port=0,
+                 health_interval_s=5.0, health_timeout_s=2.0,
+                 predict_timeout_s=30.0, attempt_timeout_s=10.0,
+                 breaker_failure_ratio=0.5, breaker_window=20,
+                 breaker_min_calls=3, breaker_open_for_s=30.0,
+                 alert_rules=None, alert_sinks=None, alert_interval_s=5.0,
+                 canary_opts=None, broker=None,
+                 broker_topic="registry_events", session_id="frontend",
+                 tracer=None, log_sinks=None):
+        super().__init__(host=host, port=port)
+        urls = [str(u).rstrip("/") for u in replicas]
+        if not urls:
+            raise ValueError("frontend needs at least one replica")
+        names = list(names) if names is not None else [None] * len(urls)
+        if len(names) != len(urls):
+            raise ValueError("names must match replicas 1:1")
+        names = [n if n else _replica_name(u) for n, u in zip(names, urls)]
+        if len(set(names)) != len(names):
+            raise ValueError(f"duplicate replica names: {names}")
+
+        self.registry = MetricsRegistry()
+        self.tracer = tracer if tracer is not None else Tracer(enabled=True)
+        self.logger = StructuredLogger(name=f"serving.{session_id}",
+                                       registry=self.registry,
+                                       sinks=log_sinks)
+        self.registry.logger = self.logger
+
+        self.replicas = [
+            ReplicaHandle(n, u, CircuitBreaker(
+                failure_ratio=breaker_failure_ratio, window=breaker_window,
+                min_calls=breaker_min_calls, open_for_s=breaker_open_for_s,
+                name=n, on_transition=self._on_breaker_transition))
+            for n, u in zip(names, urls)]
+
+        self.health_interval_s = float(health_interval_s)
+        self.health_timeout_s = float(health_timeout_s)
+        self.predict_timeout_s = float(predict_timeout_s)
+        self.attempt_timeout_s = float(attempt_timeout_s)
+        self._last_health_poll = None
+        self._health_poll_lock = threading.Lock()
+        self._route_lock = threading.Lock()
+        self._rr = 0                   # round-robin cursor
+        self._canary_acc = 0.0         # deterministic fraction accumulator
+
+        # instruments: the canary controller's SLO rules window the
+        # cohort-labeled attempt/error counters; breaker + weight gauges
+        # make ejection visible on any /metrics or /fleet/metrics scrape
+        self.m_attempts = self.registry.counter(
+            "frontend_attempts_total",
+            "Replica /predict attempts, by cohort")
+        self.m_errors = self.registry.counter(
+            "frontend_errors_total",
+            "Failed replica /predict attempts, by cohort")
+        self.m_requests = self.registry.counter(
+            "frontend_requests_total",
+            "Client requests answered, by final status code")
+        self.m_failovers = self.registry.counter(
+            "frontend_failovers_total",
+            "Requests retried on a different replica")
+        self.m_breaker_transitions = self.registry.counter(
+            "breaker_transitions_total",
+            "Circuit-breaker state changes, by replica and new state")
+        self.m_latency = self.registry.histogram(
+            "frontend_latency_ms", "Frontend request latency (ms)")
+        for c in (self.m_failovers,):
+            c.inc(0)
+        for cohort in (STABLE, CANARY):
+            self.m_attempts.inc(0, cohort=cohort)
+            self.m_errors.inc(0, cohort=cohort)
+        g = self.registry.gauge(
+            "breaker_state",
+            "Per-replica circuit state (0 closed, 1 half-open, 2 open)",
+            fn=lambda: {r.name: float(r.breaker.state_code)
+                        for r in self.replicas})
+        g.fn_label = "replica"
+        g = self.registry.gauge(
+            "frontend_replica_weight",
+            "Per-replica routing weight from deep health",
+            fn=lambda: {r.name: r.weight() for r in self.replicas})
+        g.fn_label = "replica"
+
+        self.health = HealthMonitor(logger=self.logger)
+        self.health.register("pool", self._probe_pool)
+        for r in self.replicas:
+            self.health.register(f"replica:{r.name}",
+                                 self._replica_probe(r))
+
+        self.alerts = AlertEngine(registry=self.registry,
+                                  rules=list(alert_rules or []),
+                                  sinks=list(alert_sinks or []),
+                                  interval_s=alert_interval_s,
+                                  logger=self.logger)
+        self.broker = broker
+        self.broker_topic = str(broker_topic)
+        from .canary import CanaryController
+        self.canary = CanaryController(self, **(canary_opts or {}))
+
+    # ---- health pool -------------------------------------------------------
+    def _on_breaker_transition(self, breaker, old, new):
+        self.m_breaker_transitions.inc(1, replica=breaker.name, state=new)
+        self.logger.log("error" if new == OPEN else "info",
+                        "breaker_transition", replica=breaker.name,
+                        previous=old, state=new)
+
+    def _replica_probe(self, replica):
+        def probe():
+            # one dead/ejected replica is DEGRADED at the frontend — the
+            # frontend still serves via failover, and a 503 here would make
+            # its load balancer pull a working front door. UNHEALTHY is the
+            # pool probe's verdict, reserved for "nothing left to route to".
+            status = replica.health
+            if status == HEALTHY or status == "unknown":
+                word = HEALTHY
+            else:
+                word = DEGRADED
+            if replica.breaker.state == OPEN:
+                word = DEGRADED         # breaker ejection is visible health
+            return word, {"url": replica.url, "cohort": replica.cohort,
+                          "reported": status,
+                          "breaker": replica.breaker.state}
+        return probe
+
+    def _probe_pool(self):
+        routable = [r for r in self.replicas if r.routable()]
+        detail = {"replicas": len(self.replicas), "routable": len(routable)}
+        if not routable:
+            return UNHEALTHY, {**detail, "reason": "no routable replica"}
+        if len(routable) < len(self.replicas):
+            return DEGRADED, {**detail, "reason": "replicas ejected/drained"}
+        return HEALTHY, detail
+
+    def poll_health(self, force=False):
+        """Refresh every replica's deep health if the cached view is older
+        than `health_interval_s` (staleness on the injected clock). Swept
+        concurrently so one wedged replica costs one timeout, not N."""
+        with self._health_poll_lock:
+            last = self._last_health_poll
+            if not force and last is not None and \
+                    monotonic_s() - last < self.health_interval_s:
+                return False
+            self._last_health_poll = monotonic_s()
+            replicas = list(self.replicas)
+
+        def sweep(replica):
+            try:
+                code, body = get_json(replica.url + "/healthz",
+                                      timeout=self.health_timeout_s,
+                                      with_status=True)
+            except Exception as e:
+                replica.health = DOWN
+                replica.health_detail = f"{type(e).__name__}: {e}"
+                return
+            word = ""
+            if isinstance(body, dict):
+                word = str(body.get("health") or body.get("status") or "")
+            word = word.lower()
+            if word == "ok":
+                word = HEALTHY
+            replica.health = word if word in _RANK else \
+                (UNHEALTHY if code >= 500 else DEGRADED)
+            replica.health_detail = body
+        _fan_out(replicas, sweep)
+        return True
+
+    # ---- routing -----------------------------------------------------------
+    def _replica(self, name):
+        for r in self.replicas:
+            if r.name == name:
+                return r
+        raise KeyError(f"unknown replica {name!r}")
+
+    def _pick_candidates(self):
+        """Ordered attempt list for one request: cohort split first (the
+        deterministic fraction accumulator sends exactly `canary_fraction`
+        of traffic to the canary cohort), then weighted round-robin inside
+        the chosen pool, with the other pool's members appended as failover
+        targets."""
+        self.poll_health()
+        routable = [r for r in self.replicas if r.routable()]
+        canary_pool = [r for r in routable if r.cohort == CANARY]
+        stable_pool = [r for r in routable if r.cohort == STABLE]
+        with self._route_lock:
+            frac = self.canary.fraction if canary_pool else 0.0
+            take_canary = False
+            if frac > 0.0:
+                self._canary_acc += frac
+                if self._canary_acc >= 1.0 - 1e-9:
+                    self._canary_acc -= 1.0
+                    take_canary = True
+            primary, fallback = (canary_pool, stable_pool) if take_canary \
+                else (stable_pool, canary_pool)
+            ordered = []
+            for pool in (primary, fallback):
+                slots = [r for r in pool
+                         for _ in range(2 if r.weight() >= 1.0 else 1)]
+                if not slots:
+                    continue
+                start = self._rr
+                self._rr += 1
+                rotated = [slots[(start + i) % len(slots)]
+                           for i in range(len(slots))]
+                for r in rotated:
+                    if r not in ordered:
+                        ordered.append(r)
+            return ordered
+
+    def _handle_predict(self, handler):
+        d = json.loads(handler.body())
+        with self.tracer.span("frontend_predict") as root:
+            t0 = monotonic_s()
+            status, payload = self._route_predict(d, root)
+            self.m_latency.observe((monotonic_s() - t0) * 1000.0)
+            root.set_attribute("status", status)
+        self.m_requests.inc(1, code=str(status))
+        handler.send_json(status, payload, default=str)
+
+    def _route_predict(self, d, root):
+        """(status, payload) for one routed /predict under a total
+        Deadline; at most MAX_ATTEMPTS real attempts on distinct replicas."""
+        # the Deadline covers candidate selection too: a stale health cache
+        # makes _pick_candidates sweep the replicas first, and that wait
+        # must spend THIS request's budget, not stack on top of it
+        with Deadline(self.predict_timeout_s):
+            candidates = self._pick_candidates()
+            if not candidates:
+                return 503, {"error": "no routable replica"}
+            last_exc, attempts = None, 0
+            for replica in candidates:
+                if attempts >= self.MAX_ATTEMPTS:
+                    break
+                if not replica.breaker.allow():
+                    continue        # half-open probe slots busy: next target
+                attempts += 1
+                failover = attempts > 1
+                cohort = replica.cohort
+                self.m_attempts.inc(1, cohort=cohort)
+                with self.tracer.span("attempt", replica=replica.name,
+                                      attempt=attempts, retry=failover,
+                                      cohort=cohort) as span:
+                    try:
+                        res = post_json(replica.url + "/predict", d,
+                                        timeout=self.attempt_timeout_s)
+                    except Exception as e:
+                        last_exc = e
+                        span.set_attribute("error", type(e).__name__)
+                        record_outcome(replica.breaker, e)
+                        self.m_errors.inc(1, cohort=cohort)
+                        self.logger.warning(
+                            "predict_attempt_failed", replica=replica.name,
+                            attempt=attempts, cohort=cohort,
+                            error=f"{type(e).__name__}: {e}")
+                        if isinstance(e, DeadlineExceededError):
+                            break             # budget spent: stop trying
+                        if not is_retryable(e):
+                            return self._client_error(e)
+                        count_retry(e, registry=self.registry)
+                        continue
+                    replica.breaker.record_success()
+                    if failover:
+                        self.m_failovers.inc(1)
+                    self.logger.debug("predict_routed",
+                                      replica=replica.name,
+                                      attempts=attempts, cohort=cohort)
+                    if isinstance(res, dict):
+                        res = {**res, "replica": replica.name,
+                               "attempts": attempts}
+                    return 200, res
+        if isinstance(last_exc, DeadlineExceededError):
+            return 504, {"error": "frontend deadline exhausted",
+                         "attempts": attempts}
+        if last_exc is None:
+            return 503, {"error": "all replicas breaker-open"}
+        return 502, {"error": f"{type(last_exc).__name__}: {last_exc}",
+                     "attempts": attempts}
+
+    @staticmethod
+    def _client_error(exc):
+        """Forward a replica's non-retryable client error verbatim-ish."""
+        if isinstance(exc, urllib.error.HTTPError):
+            try:
+                body = json.loads(exc.read() or b"{}")
+            except ValueError:
+                body = {"error": str(exc)}
+            return exc.code, body
+        return 502, {"error": f"{type(exc).__name__}: {exc}"}
+
+    # ---- deploy fan-out ----------------------------------------------------
+    def publish_registry_event(self, event):
+        """Fan a registry-change event over the broker topic (no-op without
+        a broker). Other hosts apply it via RegistrySubscriber."""
+        if self.broker is None:
+            return False
+        try:
+            self.broker.publish(self.broker_topic, dict(event))
+            return True
+        except Exception as e:
+            self.logger.warning("registry_event_publish_failed",
+                                error=f"{type(e).__name__}: {e}")
+            return False
+
+    def broadcast(self, path, body, replicas=None, timeout=60.0):
+        """POST `body` to every (or the given) replica; returns
+        {name: response | {"error": ...}} without aborting on the first
+        failure — a half-deployed fleet must be visible, not hidden.
+        Fanned out via _fan_out like the health sweep: a wedged replica
+        costs one timeout, not N (a fleet /deploy or canary promote must
+        not stall behind each dead replica in turn)."""
+        out = {}
+
+        def send(replica):
+            try:
+                out[replica.name] = post_json(replica.url + path, body,
+                                              timeout=timeout)
+            except Exception as e:
+                out[replica.name] = {"error": f"{type(e).__name__}: {e}"}
+        _fan_out(replicas if replicas is not None else self.replicas, send)
+        return out
+
+    def _handle_deploy(self, handler):
+        d = json.loads(handler.body() or b"{}")
+        version = d["version"]
+        frac = d.get("canary")
+        if frac is not None:
+            state = self.canary.start(version, float(frac),
+                                      path=d.get("path"),
+                                      replica=d.get("replica"))
+            handler.send_json(200, {"canary": state}, default=str)
+            return
+        results = self.broadcast("/deploy", {
+            "version": version, **({"path": d["path"]} if "path" in d
+                                   else {})})
+        ok = [n for n, r in results.items()
+              if isinstance(r, dict) and "error" not in r]
+        for replica in self.replicas:
+            # a fleet-wide deploy that REACHED a replica re-admits it to the
+            # stable cohort — including one stranded by a failed canary
+            # rollback, which now runs the fleet version again
+            if replica.name in ok:
+                replica.cohort = STABLE
+        self.logger.info("fleet_deploy", version=version, ok=len(ok),
+                         failed=len(results) - len(ok))
+        self.publish_registry_event({"kind": "deploy", "version": version,
+                                     **({"path": d["path"]} if "path" in d
+                                        else {})})
+        handler.send_json(200 if len(ok) == len(results) else 502,
+                          {"version": version, "results": results},
+                          default=str)
+
+    def _handle_rollback(self, handler):
+        from . import canary as canary_states
+        state = self.canary.state
+        if state == canary_states.OBSERVING:
+            status = self.canary.rollback(reason="manual")
+            handler.send_json(200, {"canary": status}, default=str)
+            return
+        if state != canary_states.IDLE:
+            # DEPLOYING/PROMOTING/ROLLING_BACK: the controller holds a
+            # broadcast in flight — a /rollback now must not be
+            # reinterpreted as "revert the ENTIRE stable fleet"
+            handler.send_json(409, {"error": f"canary {state}; retry when "
+                                             "the transition settles"})
+            return
+        results = self.broadcast("/rollback", {})
+        self.logger.info("fleet_rollback")
+        self.publish_registry_event({"kind": "rollback"})
+        handler.send_json(200, {"results": results}, default=str)
+
+    # ---- views -------------------------------------------------------------
+    def _healthz(self):
+        self.poll_health()
+        h = self.health.check()
+        return {"status": "ok" if h["status"] == HEALTHY else h["status"],
+                "health": h["status"],
+                "components": h["components"],
+                "canary": self.canary.status(),
+                "replicas": {r.name: r.to_dict() for r in self.replicas}}
+
+    def _metrics_snapshot(self):
+        snap = self.registry.snapshot()
+        snap["replicas"] = {r.name: r.to_dict() for r in self.replicas}
+        return snap
+
+    # ---- lifecycle ---------------------------------------------------------
+    def start(self):
+        if self._httpd is not None:
+            return self
+        self.alerts.start()
+        frontend = self
+
+        class Handler(QuietHandler):
+            def _traced(self, fn):
+                with server_span(frontend.tracer, self.headers,
+                                 "http " + self.path.partition("?")[0]):
+                    return fn()
+
+            def do_GET(self):
+                self._traced(self._do_get)
+
+            def do_POST(self):
+                self._traced(self._do_post)
+
+            def _do_get(self):
+                u = urlparse(self.path)
+                query = {k: v[0] for k, v in parse_qs(u.query).items()}
+                if u.path == "/healthz":
+                    report = frontend._healthz()
+                    self.send_json(
+                        503 if report["health"] == UNHEALTHY else 200,
+                        report, default=str)
+                elif u.path == "/metrics":
+                    if query.get("format") == "prometheus":
+                        self.send_text(200, frontend.registry.to_prometheus(),
+                                       content_type=PROMETHEUS_CONTENT_TYPE)
+                    else:
+                        self.send_json(200, frontend._metrics_snapshot(),
+                                       default=str)
+                elif u.path == "/replicas":
+                    frontend.poll_health()
+                    self.send_json(200, {
+                        "replicas": {r.name: r.to_dict()
+                                     for r in frontend.replicas},
+                        "canary": frontend.canary.status()}, default=str)
+                elif u.path == "/alerts":
+                    state = frontend.alerts.state()
+                    state["canary"] = frontend.canary.status()
+                    self.send_json(200, state, default=str)
+                elif u.path == "/logs":
+                    try:
+                        payload = frontend.logger.buffer.to_dict(
+                            level=query.get("level"),
+                            n=int(query.get("n", 256)),
+                            trace_id=query.get("trace_id"))
+                    except ValueError as e:
+                        self.send_json(400, {"error": f"bad query: {e}"})
+                        return
+                    self.send_json(200, payload, default=str)
+                elif u.path == "/trace":
+                    self.send_json(200, frontend.tracer.to_chrome_trace())
+                else:
+                    self.send_json(404, {"error": "not found"})
+
+            def _do_post(self):
+                try:
+                    if self.path == "/predict":
+                        frontend._handle_predict(self)
+                    elif self.path == "/deploy":
+                        frontend._handle_deploy(self)
+                    elif self.path == "/rollback":
+                        frontend._handle_rollback(self)
+                    else:
+                        self.send_json(404, {"error": "not found"})
+                except Exception as e:
+                    self.send_json(400,
+                                   {"error": f"{type(e).__name__}: {e}"})
+
+        return self.start_with(Handler)
+
+    def stop(self):
+        self.alerts.stop()
+        super().stop()
+
+
+class RegistrySubscriber:
+    """Apply broker-fanned registry-change events to a local ServingServer:
+    the cross-host half of the shared `scan_dir` registry. One subscriber
+    per serving host polls the topic and applies each event against its own
+    registry — `deploy` re-scans the shared directory first (the zip may
+    have just landed), `scan` refreshes, `rollback` rolls back. A failing
+    apply is recorded and counted, never fatal to the loop."""
+
+    def __init__(self, server, client, topic="registry_events",
+                 poll_timeout_s=0.5):
+        self.server = server
+        self.client = client
+        self.topic = str(topic)
+        self.poll_timeout_s = float(poll_timeout_s)
+        self.applied = 0
+        self.errors = []               # bounded
+        self._stop = threading.Event()
+        self._thread = None
+
+    def apply(self, event):
+        """Apply one registry event; returns True when it changed state."""
+        kind = event.get("kind")
+        if kind == "deploy":
+            reg = self.server.registry
+            if reg.scan_dir is not None:
+                reg.scan()             # the zip may have just landed
+            version = str(event["version"])
+            known = any(v["version"] == version for v in reg.versions())
+            self.server.deploy(version,
+                               path=None if known else event.get("path"))
+            return True
+        if kind == "scan":
+            return bool(self.server.registry.scan())
+        if kind == "rollback":
+            self.server.rollback()
+            return True
+        return False                   # canary_* and unknown kinds: ignore
+
+    def _loop(self):
+        while not self._stop.is_set():
+            try:
+                msg = self.client.poll(self.topic,
+                                       timeout=self.poll_timeout_s)
+            except Exception as e:
+                self._record_error(e)
+                continue
+            if msg is None:
+                continue
+            try:
+                if self.apply(msg):
+                    self.applied += 1
+            except Exception as e:
+                self._record_error(e, event=msg)
+
+    def _record_error(self, exc, event=None):
+        if len(self.errors) < 100:
+            self.errors.append({"error": f"{type(exc).__name__}: {exc}",
+                                "event": event})
+
+    def start(self):
+        if self._thread is not None and self._thread.is_alive():
+            return self
+        self._stop.clear()
+        self._thread = threading.Thread(target=self._loop, daemon=True,
+                                        name="registry-subscriber")
+        self._thread.start()
+        return self
+
+    def close(self, timeout=5.0):
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout)
+            self._thread = None
+        self.client.close()

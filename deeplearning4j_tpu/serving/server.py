@@ -143,8 +143,15 @@ class ServingServer(BackgroundHttpServer):
         if self.registry.active_version is None:
             return "unhealthy", {"reason": "no model deployed",
                                  "registered": len(versions)}
-        return "healthy", {"active": self.registry.active_version,
-                           "registered": len(versions)}
+        detail = {"active": self.registry.active_version,
+                  "registered": len(versions)}
+        if self.registry.scan_errors:
+            # a zip the startup scan could not load was previously recorded
+            # but invisible to the health plane (and so to the fleet view):
+            # surface it as degraded — the server serves, the debt shows
+            return "degraded", {**detail, "reason": "registry scan errors",
+                                "scan_errors": dict(self.registry.scan_errors)}
+        return "healthy", detail
 
     # ---- programmatic API --------------------------------------------------
     def submit(self, x, timeout_ms=None):

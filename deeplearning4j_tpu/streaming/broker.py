@@ -242,41 +242,52 @@ class BrokerClient:
         self.retry_interval = float(retry_interval)
         self._sock = None
         self._lock = threading.Lock()
+        # constant-interval reconnect cadence (multiplier 1.0 pins the
+        # jitter window to [interval, interval]), transport faults only —
+        # a broker-side BrokerError rejection is a hard error, not a retry
+        from ..resilience.policy import RetryPolicy
+        self._retry = RetryPolicy(max_attempts=self.retries + 1,
+                                  base_s=self.retry_interval,
+                                  cap_s=self.retry_interval, multiplier=1.0,
+                                  retry_on=(OSError, ConnectionError))
 
     def _connect(self):
         s = socket.create_connection((self.host, self.port), timeout=30)
         s.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
         return s
 
+    def _attempt(self, obj):
+        """One request over the (re)opened socket; a transport fault closes
+        the socket so the next attempt reconnects fresh."""
+        try:
+            if self._sock is None:
+                self._sock = self._connect()
+            _send_frame(self._sock, obj)
+            resp = _recv_frame(self._sock)
+            if resp is None:
+                raise ConnectionError("broker closed the connection")
+            if isinstance(resp, dict) and "error" in resp:
+                # broker-side rejection is a hard error, not a retry
+                # case — surface it instead of a KeyError downstream
+                raise BrokerError(resp["error"])
+            return resp
+        except (OSError, ConnectionError):
+            if self._sock is not None:
+                try:
+                    self._sock.close()
+                except OSError:
+                    pass
+                self._sock = None
+            raise
+
     def _request(self, obj):
         with self._lock:
-            last = None
-            for attempt in range(self.retries + 1):
-                try:
-                    if self._sock is None:
-                        self._sock = self._connect()
-                    _send_frame(self._sock, obj)
-                    resp = _recv_frame(self._sock)
-                    if resp is None:
-                        raise ConnectionError("broker closed the connection")
-                    if isinstance(resp, dict) and "error" in resp:
-                        # broker-side rejection is a hard error, not a retry
-                        # case — surface it instead of a KeyError downstream
-                        raise BrokerError(resp["error"])
-                    return resp
-                except (OSError, ConnectionError) as e:
-                    last = e
-                    if self._sock is not None:
-                        try:
-                            self._sock.close()
-                        except OSError:
-                            pass
-                        self._sock = None
-                    if attempt < self.retries:
-                        time.sleep(self.retry_interval)
-            raise ConnectionError(
-                f"broker at {self.host}:{self.port} unreachable after "
-                f"{self.retries + 1} attempts") from last
+            try:
+                return self._retry.call(self._attempt, obj)
+            except (OSError, ConnectionError) as last:
+                raise ConnectionError(
+                    f"broker at {self.host}:{self.port} unreachable after "
+                    f"{self.retries + 1} attempts") from last
 
     def publish(self, topic, msg_dict):
         # unique id makes retry-after-lost-response idempotent broker-side;

@@ -106,12 +106,14 @@ class AlertRule:
              "threshold": self.threshold, "severity": self.severity,
              "for_duration_s": self.for_duration_s,
              "description": self.description}
+        if self.labels:
+            # labels scope ANY kind: a threshold on one label-set, or a
+            # ratio/burn_rate over one cohort's counters (the canary case)
+            d["labels"] = dict(self.labels)
         if self.kind == "threshold":
             d["metric"] = self.metric
             if self.percentile is not None:
                 d["percentile"] = self.percentile
-            if self.labels:
-                d["labels"] = dict(self.labels)
         else:
             d["numerator"] = list(self.numerator)
             d["denominator"] = list(self.denominator)
@@ -189,6 +191,18 @@ class AlertEngine:
             self.rules = [r for r in self.rules if r.name != name]
         self._resolve_displaced(old)
 
+    def drop_history(self, names, labels=None):
+        """Forget the windowed samples for `names` under the given label
+        scope. Counter history outlives rules (so a re-added long-lived rule
+        keeps its window), which means a windowed rule re-added over a
+        REUSED label-set — back-to-back canary cohorts — would otherwise see
+        the previous occupant's deltas in its window and could fire on
+        traffic the new deploy never served."""
+        lk = tuple(sorted((labels or {}).items()))
+        with self._lock:
+            for name in names:
+                self._history.pop((name, lk), None)
+
     def _resolve_displaced(self, old_rules):
         """A FIRING rule that is replaced/removed must still resolve: its
         receiver (pager, Alertmanager) has an open incident keyed on the
@@ -204,33 +218,37 @@ class AlertEngine:
 
     # ---- evaluation --------------------------------------------------------
     def _sample_counters(self, now):
-        """Record current totals for every windowed rule's counters and
-        prune history past the largest window."""
+        """Record current totals for every windowed rule's counters (per the
+        rule's label scope — a labeled rule windows one label-set's series,
+        an unlabeled one the summed total) and prune history past the
+        largest window."""
         with self._lock:
             rules = list(self.rules)
-        names, max_window = set(), 0.0
+        keys, max_window = set(), 0.0
         for r in rules:
             if r.kind in ("ratio", "burn_rate"):
-                names.update(r.numerator)
-                names.update(r.denominator)
+                lk = tuple(sorted(r.labels.items()))
+                keys.update((n, lk) for n in r.numerator)
+                keys.update((n, lk) for n in r.denominator)
                 max_window = max(max_window, r.window_s)
-        for name in names:
-            v = _instrument_value(self.registry, name)
-            hist = self._history.setdefault(name, [])
+        for name, lk in keys:
+            v = _instrument_value(self.registry, name, labels=dict(lk))
+            hist = self._history.setdefault((name, lk), [])
             hist.append((now, 0.0 if v is None else float(v)))
             # keep one sample at-or-before the window edge as the baseline
             cut = now - max_window
             while len(hist) >= 2 and hist[1][0] <= cut:
                 hist.pop(0)
 
-    def _window_delta(self, names, window_s, now):
+    def _window_delta(self, names, window_s, now, labels=None):
         """Sum of counter increases over the last `window_s` (baseline = the
         newest sample at-or-before the window edge, else the oldest known —
         so a counter that was already nonzero at engine start never reads as
         a burst)."""
+        lk = tuple(sorted((labels or {}).items()))
         total = 0.0
         for name in names:
-            hist = self._history.get(name)
+            hist = self._history.get((name, lk))
             if not hist:
                 return None
             base = hist[0][1]
@@ -251,8 +269,10 @@ class AlertEngine:
             if v is None:
                 return False, None
             return _OPS[rule.op](float(v), rule.threshold), float(v)
-        dn = self._window_delta(rule.numerator, rule.window_s, now)
-        dd = self._window_delta(rule.denominator, rule.window_s, now)
+        dn = self._window_delta(rule.numerator, rule.window_s, now,
+                                labels=rule.labels)
+        dd = self._window_delta(rule.denominator, rule.window_s, now,
+                                labels=rule.labels)
         if dn is None or dd is None or dd <= 0:
             return False, None
         v = dn / dd

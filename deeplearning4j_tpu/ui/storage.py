@@ -178,21 +178,27 @@ class RemoteUIStatsStorageRouter(StatsStorageRouter):
         self.url = url.rstrip("/") + "/remoteReceive"
         self.max_retries = max_retries
         self.backoff_base_ms = backoff_base_ms
+        # one RetryPolicy instead of the hand-rolled loop (GL009): jittered
+        # exponential backoff between attempts, retrying on ANY failure like
+        # the reference's maxRetryCount semantics (stats delivery is
+        # fire-and-forget; a 4xx here is still just "report not delivered")
+        from ..resilience.policy import RetryPolicy
+        self._retry = RetryPolicy(max_attempts=max_retries + 1,
+                                  base_s=backoff_base_ms / 1000.0,
+                                  cap_s=backoff_base_ms / 1000.0
+                                  * (2 ** max(max_retries - 1, 0)),
+                                  retry_on=lambda e: True)
 
     def _post(self, d):
-        import time
         # util.http.post_json is the outbound choke point (GL008): strict
         # JSON body (NaN scores/numpy scalars survive, GL002) AND the
         # current trace context injected as a traceparent header
         from ..util.http import post_json
-        for attempt in range(self.max_retries + 1):
-            try:
-                post_json(self.url, d, timeout=5)
-                return True
-            except Exception:
-                if attempt == self.max_retries:
-                    return False
-                time.sleep(self.backoff_base_ms / 1000.0 * (2 ** attempt))
+        try:
+            self._retry.call(post_json, self.url, d, timeout=5)
+            return True
+        except Exception:
+            return False
 
     def put_static_info(self, report):
         self._post(_as_dict(report))

@@ -6,12 +6,19 @@ thread, port-0 resolution, shutdown/close, and JSON response writing.
 """
 from __future__ import annotations
 
+import email.message
+import io
 import json
 import math
 import threading
 import urllib.error
 import urllib.request
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+#: sane default socket timeout for every outbound call: a caller passing
+#: timeout=None gets THIS, never an infinite wait — no urlopen in the repo
+#: may hang its caller forever (resilience-PR audit)
+DEFAULT_TIMEOUT_S = 5.0
 
 
 def _sanitize_nonfinite(obj, default=None):
@@ -117,21 +124,88 @@ def _decode_response(data):
         return data.decode(errors="replace")
 
 
-def post_json(url, obj, timeout=5.0, headers=None):
+# ---- resilience seams -------------------------------------------------------
+# This module is THE outbound choke point (graftlint GL008), which makes it
+# the one place where (a) thread-propagated Deadlines clamp every socket
+# timeout, (b) RetryPolicy/CircuitBreaker compose around any hop via the
+# retry=/breaker= parameters, and (c) a chaos FaultPlan intercepts requests
+# for deterministic failure injection (resilience/chaos.py).
+
+_fault_injector = None      # callable(method, url, timeout) or None
+
+
+def set_fault_injector(fn):
+    """Install (fn) or clear (None) the chaos interceptor; returns the
+    previous one so plans can nest/restore. The injector may return None
+    (pass through), return `(status, body)` for a canned response, or raise
+    the injected transport error. Production code never sets this —
+    resilience.chaos.FaultPlan owns the seam."""
+    global _fault_injector
+    prev, _fault_injector = _fault_injector, fn
+    return prev
+
+
+def _effective_timeout(timeout):
+    """Explicit timeout (or the module default), clamped to the calling
+    thread's active resilience.Deadline — a hop may never outlive its
+    caller's total budget, and an already-spent budget fails fast with
+    DeadlineExceededError instead of opening a socket."""
+    t = DEFAULT_TIMEOUT_S if timeout is None else float(timeout)
+    from ..resilience.policy import current_deadline
+    dl = current_deadline()
+    return t if dl is None else dl.clamp(t)
+
+
+def _canned_http_error(url, status, payload):
+    """An injected error status shaped exactly like urllib would raise it
+    (readable body), so retry/breaker/fleet code paths can't tell chaos
+    from a real failing server."""
+    body = dumps_http(payload if payload is not None else {}).encode()
+    return urllib.error.HTTPError(url, status, "injected fault",
+                                  email.message.Message(), io.BytesIO(body))
+
+
+def _with_resilience(send, retry, breaker):
+    if retry is None and breaker is None:
+        return send()
+    from ..resilience.policy import guarded_call
+    return guarded_call(send, retry=retry, breaker=breaker)
+
+
+def post_json(url, obj, timeout=None, headers=None, retry=None, breaker=None):
     """Client-side JSON POST (webhook sinks, remote routers, predict
     clients): returns the decoded JSON response body, or None for an empty
     body. Serializes with dumps_http (strict JSON + numpy-aware default) and
-    injects the current trace context as a `traceparent` header."""
+    injects the current trace context as a `traceparent` header.
+
+    `timeout=None` means DEFAULT_TIMEOUT_S (never an infinite socket wait),
+    and every timeout is clamped to the thread's active resilience.Deadline.
+    `retry` (a RetryPolicy) and `breaker` (a CircuitBreaker) make this THE
+    resilient client for any hop that wants them."""
     body = dumps_http(obj).encode()
     hdrs = {"Content-Type": "application/json"}
     hdrs.update(_client_headers(headers))
-    req = urllib.request.Request(url, data=body, headers=hdrs)
-    with urllib.request.urlopen(req, timeout=timeout) as resp:
-        data = resp.read()
-    return _decode_response(data)
+
+    def send():
+        t = _effective_timeout(timeout)
+        inj = _fault_injector
+        if inj is not None:
+            canned = inj("POST", url, t)
+            if canned is not None:
+                status, payload = canned
+                if status >= 400:
+                    raise _canned_http_error(url, status, payload)
+                return payload
+        req = urllib.request.Request(url, data=body, headers=hdrs)
+        with urllib.request.urlopen(req, timeout=t) as resp:
+            data = resp.read()
+        return _decode_response(data)
+
+    return _with_resilience(send, retry, breaker)
 
 
-def get_json(url, timeout=5.0, headers=None, with_status=False):
+def get_json(url, timeout=None, headers=None, with_status=False,
+             retry=None, breaker=None):
     """Client-side JSON GET with trace-context injection (the scrape/poll
     half of post_json — fleet collection, smoke tools, health probes).
 
@@ -139,17 +213,32 @@ def get_json(url, timeout=5.0, headers=None, with_status=False):
     error statuses like any urllib client. `with_status=True` returns
     `(status, decoded_body)` and decodes error-status bodies instead of
     raising — a deep-health 503 response IS the payload a fleet collector
-    wants, not an exception."""
-    req = urllib.request.Request(url, headers=_client_headers(headers))
-    try:
-        with urllib.request.urlopen(req, timeout=timeout) as resp:
-            status, data = resp.status, resp.read()
-    except urllib.error.HTTPError as e:
-        if not with_status:
-            raise
-        status, data = e.code, e.read()
-    decoded = _decode_response(data)
-    return (status, decoded) if with_status else decoded
+    wants, not an exception. Timeout semantics and `retry`/`breaker` match
+    post_json."""
+    hdrs = _client_headers(headers)
+
+    def send():
+        t = _effective_timeout(timeout)
+        inj = _fault_injector
+        if inj is not None:
+            canned = inj("GET", url, t)
+            if canned is not None:
+                status, payload = canned
+                if status >= 400 and not with_status:
+                    raise _canned_http_error(url, status, payload)
+                return (status, payload) if with_status else payload
+        req = urllib.request.Request(url, headers=hdrs)
+        try:
+            with urllib.request.urlopen(req, timeout=t) as resp:
+                status, data = resp.status, resp.read()
+        except urllib.error.HTTPError as e:
+            if not with_status:
+                raise
+            status, data = e.code, e.read()
+        decoded = _decode_response(data)
+        return (status, decoded) if with_status else decoded
+
+    return _with_resilience(send, retry, breaker)
 
 
 def read_body(handler: BaseHTTPRequestHandler) -> bytes:

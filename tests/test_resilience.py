@@ -1,0 +1,1008 @@
+"""Resilience layer: retry/backoff/circuit-breaker policies, deadline
+propagation, deterministic chaos injection, the health-aware FleetFrontend
+with single-failover routing, and alert-gated canary deploys.
+
+The acceptance tests at the bottom drive the ISSUE-8 react loop live with
+ZERO real sleeps (ManualClock): one of two replicas dies mid-traffic -> the
+frontend fails over (client error rate stays 0) -> the dead replica's
+breaker shows `open` in /fleet/metrics -> recovery + a half-open probe
+restore two-replica routing; a canary whose injected error ratio breaches
+the SLO rule auto-rolls-back without any 5xx reaching clients and a healthy
+canary auto-promotes (both visible in /alerts and /logs); a failed-over
+request is ONE trace through the frontend's attempt spans and the winning
+replica's server span, verified via /fleet/trace.
+"""
+import urllib.error
+
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu.resilience import (CircuitBreaker, CircuitOpenError,
+                                           Deadline, DeadlineExceededError,
+                                           FaultPlan, FaultRule, RetryBudget,
+                                           RetryPolicy, current_deadline,
+                                           deadline, guarded_call,
+                                           is_retryable, is_server_fault)
+from deeplearning4j_tpu.serving import FleetFrontend, ServingServer
+from deeplearning4j_tpu.telemetry import FleetServer, MetricsRegistry, Tracer
+from deeplearning4j_tpu.util.http import DEFAULT_TIMEOUT_S, get_json, post_json
+from deeplearning4j_tpu.util.time_source import (ManualClock,
+                                                 TimeSourceProvider)
+
+
+@pytest.fixture
+def manual_clock():
+    clock = ManualClock(start_s=1000.0)
+    TimeSourceProvider.set_instance(clock)
+    try:
+        yield clock
+    finally:
+        TimeSourceProvider.reset()
+
+
+class StubModel:
+    def __init__(self, factor=2.0):
+        self.factor = factor
+
+    def output(self, x):
+        return np.asarray(x) * self.factor
+
+
+def _http_error(code):
+    import email.message
+    import io
+    return urllib.error.HTTPError("http://x", code, "err",
+                                  email.message.Message(), io.BytesIO(b"{}"))
+
+
+# ------------------------------------------------------------ retry policy
+
+def test_retry_exhaustion_raises_the_last_underlying_error(manual_clock):
+    """Satellite: on attempt exhaustion the LAST real failure surfaces —
+    never a synthetic 'retries exceeded' hiding it. Zero real sleeps."""
+    errors = [ConnectionResetError("first"), TimeoutError("second"),
+              ConnectionRefusedError("third and final")]
+    calls = []
+
+    def flaky():
+        calls.append(1)
+        raise errors[len(calls) - 1]
+
+    policy = RetryPolicy(max_attempts=3, base_s=0.1,
+                         sleep=manual_clock.advance)
+    with pytest.raises(ConnectionRefusedError, match="third and final"):
+        policy.call(flaky)
+    assert len(calls) == 3 and policy.attempts_made == 3
+
+
+def test_retry_budget_exhaustion_raises_last_error_not_a_wrapper(
+        manual_clock):
+    """Satellite: an empty budget denies the retry and the last underlying
+    error raises immediately (no budget -> no amplification)."""
+    budget = RetryBudget(capacity=1.0, refill_per_s=0.0)
+    calls = []
+
+    def always_down():
+        calls.append(1)
+        raise ConnectionResetError(f"attempt {len(calls)}")
+
+    policy = RetryPolicy(max_attempts=5, base_s=0.01, budget=budget,
+                         sleep=manual_clock.advance)
+    with pytest.raises(ConnectionResetError, match="attempt 2"):
+        policy.call(always_down)
+    assert len(calls) == 2           # 1 retry allowed, the 2nd denied
+    assert budget.denied == 1
+
+
+def test_retry_budget_refills_on_the_injected_clock(manual_clock):
+    budget = RetryBudget(capacity=2.0, refill_per_s=1.0)
+    assert budget.try_spend() and budget.try_spend()
+    assert not budget.try_spend()
+    manual_clock.advance(1.5)
+    assert budget.tokens() == pytest.approx(1.5)
+    assert budget.try_spend()
+
+
+def test_jittered_backoff_stays_within_base_and_cap():
+    """Satellite: for every attempt the jittered delay lands in
+    [base_s, min(cap_s, base_s * multiplier**attempt)]."""
+    import random
+    policy = RetryPolicy(max_attempts=3, base_s=0.1, cap_s=5.0,
+                         multiplier=2.0, rng=random.Random(7))
+    for attempt in range(16):
+        ceiling = min(5.0, 0.1 * 2.0 ** attempt)
+        for _ in range(50):
+            b = policy.backoff_s(attempt)
+            assert 0.1 <= b + 1e-12 and b <= ceiling + 1e-12
+            assert b <= 5.0 + 1e-12
+
+
+def test_retry_sleeps_are_the_jittered_backoffs(manual_clock):
+    slept = []
+    policy = RetryPolicy(max_attempts=4, base_s=0.5, cap_s=2.0,
+                         sleep=slept.append)
+    with pytest.raises(ConnectionResetError):
+        policy.call(lambda: (_ for _ in ()).throw(ConnectionResetError()))
+    assert len(slept) == 3
+    assert all(0.5 <= s <= 2.0 for s in slept)
+
+
+def test_retry_stops_when_the_total_deadline_is_spent(manual_clock):
+    """total_timeout_s bounds the whole retry chain on the injected clock:
+    once backoff sleeps consume it, the last error raises early."""
+    calls = []
+
+    def down():
+        calls.append(1)
+        raise ConnectionResetError("down")
+
+    policy = RetryPolicy(max_attempts=50, base_s=1.0, cap_s=1.0,
+                         total_timeout_s=2.5, sleep=manual_clock.advance)
+    with pytest.raises(ConnectionResetError):
+        policy.call(down)
+    assert len(calls) < 50           # exhausted the budget, not the attempts
+    assert 2 <= len(calls) <= 4
+
+
+def test_retry_does_not_retry_non_retryable_errors(manual_clock):
+    calls = []
+
+    def bad_request():
+        calls.append(1)
+        raise _http_error(404)
+
+    policy = RetryPolicy(max_attempts=5, sleep=manual_clock.advance)
+    with pytest.raises(urllib.error.HTTPError):
+        policy.call(bad_request)
+    assert len(calls) == 1
+
+
+def test_retries_count_into_retries_total_by_reason(manual_clock):
+    reg = MetricsRegistry()
+    policy = RetryPolicy(max_attempts=3, base_s=0.01, registry=reg,
+                         sleep=manual_clock.advance)
+    with pytest.raises(ConnectionResetError):
+        policy.call(lambda: (_ for _ in ()).throw(ConnectionResetError()))
+    c = reg.get("retries_total")
+    assert c.get(reason="ConnectionResetError") == 2
+
+
+def test_retryability_classification():
+    assert is_retryable(_http_error(500)) and is_retryable(_http_error(429))
+    assert not is_retryable(_http_error(404))
+    assert is_retryable(ConnectionResetError()) and is_retryable(OSError())
+    assert not is_retryable(DeadlineExceededError())
+    assert not is_retryable(CircuitOpenError())
+    assert not is_retryable(ValueError())
+    # 429 is the server protecting itself, not the server being broken
+    assert is_server_fault(_http_error(500))
+    assert not is_server_fault(_http_error(429))
+    assert not is_server_fault(CircuitOpenError())
+    # protocol corruption mid-response (BadStatusLine/IncompleteRead are
+    # HTTPException, NOT OSError): the peer is as dead as a reset one —
+    # retryable AND a server fault (the breaker must open, not record
+    # success as if the target had answered)
+    import http.client
+    assert is_retryable(http.client.BadStatusLine("garbage"))
+    assert is_server_fault(http.client.IncompleteRead(b"partial"))
+
+
+def test_record_outcome_counts_protocol_corruption_as_failure(manual_clock):
+    """A replica emitting garbage status lines must open its breaker like
+    one refusing connections — not accrue successes."""
+    import http.client
+    from deeplearning4j_tpu.resilience.policy import record_outcome
+    br = CircuitBreaker(min_calls=2, failure_ratio=0.5, window=10)
+    record_outcome(br, http.client.BadStatusLine("x"))
+    record_outcome(br, http.client.RemoteDisconnected("y"))
+    assert br.state == "open"
+
+
+# --------------------------------------------------------------- deadlines
+
+def test_deadline_clamps_and_expires_on_the_injected_clock(manual_clock):
+    with deadline(2.0) as dl:
+        assert current_deadline() is dl
+        assert dl.clamp(5.0) == pytest.approx(2.0)
+        assert dl.clamp(0.5) == pytest.approx(0.5)
+        manual_clock.advance(1.5)
+        assert dl.remaining() == pytest.approx(0.5)
+        manual_clock.advance(1.0)
+        assert dl.expired
+        with pytest.raises(DeadlineExceededError):
+            dl.clamp(1.0)
+    assert current_deadline() is None
+
+
+def test_deadlines_nest_and_unbounded_never_expires(manual_clock):
+    unbounded = Deadline(None)
+    assert unbounded.remaining() is None and not unbounded.expired
+    assert unbounded.clamp(3.0) == 3.0 and unbounded.clamp(None) is None
+    with deadline(10.0):
+        with deadline(1.0) as inner:
+            assert current_deadline() is inner      # innermost wins
+        outer = current_deadline()
+        assert outer is not None and outer.timeout_s == 10.0
+
+
+def test_inner_deadline_cannot_outlive_the_enclosing_one(manual_clock):
+    """Nested budgets only SHRINK: entering a LONGER inner deadline (e.g.
+    RetryPolicy(total_timeout_s=60) inside `with deadline(0.5)`) must keep
+    the outer expiry, or the inner scope would un-clamp socket timeouts
+    past the caller's total budget."""
+    with deadline(0.5):
+        with deadline(60.0) as inner:
+            assert inner.remaining() == pytest.approx(0.5)
+        with Deadline(None) as unbounded:       # unbounded inherits too
+            assert unbounded.remaining() == pytest.approx(0.5)
+        manual_clock.advance(0.6)
+        with deadline(60.0) as spent:
+            assert spent.expired
+            with pytest.raises(DeadlineExceededError):
+                spent.clamp(1.0)
+    # a fresh top-level deadline is unaffected
+    with deadline(60.0) as top:
+        assert top.remaining() == pytest.approx(60.0)
+
+
+def test_util_http_clamps_to_the_active_deadline(manual_clock, monkeypatch):
+    """Satellite: every outbound call gets an explicit socket timeout —
+    DEFAULT_TIMEOUT_S when none is given — clamped to the thread's Deadline;
+    a spent budget fails fast WITHOUT opening a socket."""
+    import deeplearning4j_tpu.util.http as http_mod
+    seen = []
+
+    class FakeResp:
+        status = 200
+
+        def read(self):
+            return b'{"ok": true}'
+
+        def __enter__(self):
+            return self
+
+        def __exit__(self, *a):
+            return False
+
+    def fake_urlopen(req, timeout=None):
+        seen.append(timeout)
+        return FakeResp()
+
+    monkeypatch.setattr(http_mod.urllib.request, "urlopen", fake_urlopen)
+    post_json("http://peer/x", {})
+    assert seen[-1] == DEFAULT_TIMEOUT_S          # never an infinite wait
+    get_json("http://peer/x", timeout=120.0)
+    assert seen[-1] == 120.0
+    with deadline(2.0):
+        post_json("http://peer/x", {}, timeout=60.0)
+        assert seen[-1] == pytest.approx(2.0)     # clamped to the budget
+        manual_clock.advance(3.0)
+        n = len(seen)
+        with pytest.raises(DeadlineExceededError):
+            post_json("http://peer/x", {})
+        assert len(seen) == n                     # no socket was opened
+
+
+# ---------------------------------------------------------- circuit breaker
+
+def _trip(breaker, n=5):
+    for _ in range(n):
+        breaker.record_failure()
+
+
+def test_breaker_half_open_recloses_after_one_success(manual_clock):
+    """Satellite: closed -> open on the failure ratio, half-open after the
+    cool-off, ONE successful probe re-closes with a clean window."""
+    br = CircuitBreaker(failure_ratio=0.5, window=10, min_calls=3,
+                        open_for_s=30.0, name="r1")
+    assert br.state == "closed" and br.allow()
+    _trip(br, 3)
+    assert br.state == "open" and br.opens == 1
+    assert not br.allow()                     # fail fast while open
+    manual_clock.advance(29.0)
+    assert not br.allow()                     # cool-off not yet elapsed
+    manual_clock.advance(1.5)
+    assert br.state == "half_open"
+    assert br.allow()                         # claims the single probe slot
+    assert not br.allow()                     # half_open_max=1: slot busy
+    br.record_success()
+    assert br.state == "closed"
+    assert br.to_dict()["window_calls"] == 0  # clean slate
+
+
+def test_breaker_half_open_reopens_after_one_failure(manual_clock):
+    br = CircuitBreaker(failure_ratio=0.5, window=10, min_calls=3,
+                        open_for_s=30.0)
+    _trip(br, 3)
+    manual_clock.advance(30.5)
+    assert br.allow()                         # the half-open probe
+    br.record_failure()
+    assert br.state == "open" and br.opens == 2
+    assert not br.allow()
+    # and the NEXT cool-off gives another probe
+    manual_clock.advance(30.5)
+    assert br.allow()
+    br.record_success()
+    assert br.state == "closed"
+
+
+def test_breaker_release_probe_frees_the_half_open_slot(manual_clock):
+    """A probe that ends with no proof either way (the CALLER'S deadline
+    expired mid-flight) must free its slot without transitioning —
+    otherwise the breaker wedges half-open, rejecting forever."""
+    br = CircuitBreaker(min_calls=2, open_for_s=10.0)
+    _trip(br, 2)
+    manual_clock.advance(10.5)
+    assert br.allow()
+    assert not br.allow()            # the single slot is claimed
+    br.release_probe()               # no-proof outcome
+    assert br.state == "half_open"   # no transition happened
+    assert br.allow()                # slot is probeable again
+    br.record_success()
+    assert br.state == "closed"
+    br.release_probe()               # closed: a no-op, never underflows
+    assert br.state == "closed"
+
+
+def test_breaker_min_calls_and_ratio_gate(manual_clock):
+    br = CircuitBreaker(failure_ratio=0.5, window=20, min_calls=5)
+    br.record_failure()                       # one early failure: no trip
+    assert br.state == "closed"
+    for _ in range(6):
+        br.record_success()
+    for _ in range(4):
+        br.record_failure()
+    assert br.state == "closed"               # 5/11 < 0.5
+    br.record_failure()
+    assert br.state == "open"                 # 6/12 >= 0.5
+
+
+def test_breaker_transitions_are_observable(manual_clock):
+    seen = []
+    br = CircuitBreaker(min_calls=2, open_for_s=5.0,
+                        on_transition=lambda b, old, new: seen.append(
+                            (old, new)))
+    _trip(br, 2)
+    manual_clock.advance(5.5)
+    br.state                                  # tick -> half-open
+    br.record_success()
+    assert seen == [("closed", "open"), ("open", "half_open"),
+                    ("half_open", "closed")]
+
+
+def test_guarded_call_composes_breaker_inside_retry(manual_clock):
+    """The breaker is consulted per ATTEMPT: once it opens mid-retry the
+    remaining attempts fail fast, and CircuitOpenError itself never
+    retries."""
+    br = CircuitBreaker(failure_ratio=0.5, window=4, min_calls=2,
+                        open_for_s=60.0, name="svc")
+    calls = []
+
+    def down():
+        calls.append(1)
+        raise ConnectionResetError("down")
+
+    retry = RetryPolicy(max_attempts=6, base_s=0.01,
+                        sleep=manual_clock.advance)
+    with pytest.raises(CircuitOpenError):
+        guarded_call(down, retry=retry, breaker=br)
+    assert len(calls) == 2                    # third attempt hit the breaker
+    assert br.state == "open"
+    n = len(calls)
+    with pytest.raises(CircuitOpenError):
+        guarded_call(down, breaker=br)        # fail fast, no call made
+    assert len(calls) == n
+    # a 4xx answer counts as the target being ALIVE (success for the breaker)
+    manual_clock.advance(61.0)
+    with pytest.raises(urllib.error.HTTPError):
+        guarded_call(lambda: (_ for _ in ()).throw(_http_error(404)),
+                     breaker=br)
+    assert br.state == "closed"               # half-open probe re-closed
+
+
+# ------------------------------------------------------------------- chaos
+
+def test_fault_plan_json_round_trip():
+    plan = FaultPlan([
+        FaultRule("reset", match="replica-b", name="kill-b"),
+        FaultRule("error", match="/predict", method="post", status=503,
+                  body={"error": "boom"}, after=2, count=5,
+                  probability=0.5),
+        FaultRule("latency", match="", latency_s=0.25, active=False),
+        FaultRule("wedge", match="/healthz"),
+        FaultRule("unhealthy", match="b:80"),
+    ], seed=7)
+    doc = plan.to_json()
+    again = FaultPlan.from_json(doc, seed=7)
+    assert again.to_json() == doc
+    assert [r.kind for r in again.rules] == ["reset", "error", "latency",
+                                             "wedge", "unhealthy"]
+    assert again.rules[1].method == "POST" and again.rules[1].after == 2
+    with pytest.raises(ValueError):
+        FaultRule("explode", match="x")
+
+
+def test_fault_rule_after_count_probability_and_method(manual_clock):
+    plan = FaultPlan([FaultRule("error", match="/p", after=1, count=2)],
+                     seed=0)
+    out = [plan.intercept("POST", "http://h/p", 5.0) for _ in range(5)]
+    assert [o is None for o in out] == [True, False, False, True, True]
+    assert plan.injected() == {"error": 2}
+    # method filter
+    plan2 = FaultPlan([FaultRule("error", match="/p", method="POST")])
+    assert plan2.intercept("GET", "http://h/p", 5.0) is None
+    assert plan2.intercept("POST", "http://h/p", 5.0) is not None
+    # seeded probability draws are reproducible
+    runs = []
+    for _ in range(2):
+        p = FaultPlan([FaultRule("error", match="", probability=0.5)],
+                      seed=42)
+        runs.append([p.intercept("GET", "u", 1.0) is not None
+                     for _ in range(20)])
+    assert runs[0] == runs[1] and 3 < sum(runs[0]) < 17
+
+
+def test_wedge_and_latency_advance_the_injected_clock(manual_clock):
+    """A wedged socket costs the caller its full timeout — paid on the
+    ManualClock, zero real sleeps; latency rules compose (non-terminal)."""
+    plan = FaultPlan([FaultRule("latency", match="/p", latency_s=2.0),
+                      FaultRule("error", match="/p", status=500)])
+    t0 = manual_clock.monotonic()
+    out = plan.intercept("POST", "http://h/p", 5.0)
+    assert out is not None and out[0] == 500
+    assert manual_clock.monotonic() - t0 == pytest.approx(2.0)
+    wedge = FaultPlan([FaultRule("wedge", match="/w")])
+    t1 = manual_clock.monotonic()
+    with pytest.raises(TimeoutError, match="wedged"):
+        wedge.intercept("GET", "http://h/w", 7.0)
+    assert manual_clock.monotonic() - t1 == pytest.approx(7.0)
+
+
+def test_set_active_scripts_kill_and_recover():
+    plan = FaultPlan([FaultRule("reset", match="b", name="kill-b")])
+    assert plan.intercept("GET", "http://a/x", 1.0) is None  # no match
+    with pytest.raises(ConnectionResetError):
+        plan.intercept("GET", "http://b/x", 1.0)
+    assert plan.set_active("kill-b", False) == 1
+    assert plan.intercept("GET", "http://b/x", 1.0) is None
+    with pytest.raises(KeyError):
+        plan.set_active("nope", False)
+
+
+def test_fault_plan_installs_into_util_http_without_sockets():
+    """The chaos seam lives in util.http: canned responses and transport
+    errors come back through post_json/get_json exactly like real ones,
+    and uninstall restores pass-through."""
+    plan = FaultPlan([
+        FaultRule("error", match="fake-host/a", status=500, name="e"),
+        FaultRule("reset", match="fake-host/r", name="r"),
+        FaultRule("unhealthy", match="fake-host/healthz", name="u"),
+        FaultRule("error", match="fake-host/ok", status=200,
+                  body={"fine": 1}, name="ok")])
+    with plan:
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            post_json("http://fake-host/a", {}, timeout=1.0)
+        assert ei.value.code == 500
+        with pytest.raises(ConnectionResetError):
+            post_json("http://fake-host/r", {}, timeout=1.0)
+        code, body = get_json("http://fake-host/healthz", timeout=1.0,
+                              with_status=True)
+        assert code == 503 and body["health"] == "unhealthy"
+        assert post_json("http://fake-host/ok", {}, timeout=1.0) == \
+            {"fine": 1}
+        # the injected HTTPError is retryable/breaker-countable like a
+        # real one
+        assert is_retryable(ei.value) and is_server_fault(ei.value)
+    from deeplearning4j_tpu.util import http as http_mod
+    assert http_mod._fault_injector is None
+
+
+# --------------------------------------------------- scan_errors satellite
+
+def test_registry_scan_errors_surface_as_degraded_health():
+    """Satellite: a zip the startup scan could not load was recorded but
+    invisible to /healthz (and so to the fleet view) — now it degrades the
+    registry component while the server keeps serving."""
+    s = ServingServer(StubModel(), port=0, alert_interval_s=0).start()
+    try:
+        code, h = get_json(s.url + "/healthz", timeout=30, with_status=True)
+        assert code == 200 and h["components"]["registry"]["status"] == \
+            "healthy"
+        s.registry.scan_errors["broken.zip"] = "BadZipFile: corrupt"
+        code, h = get_json(s.url + "/healthz", timeout=30, with_status=True)
+        assert code == 200                      # degraded serves, 503 never
+        assert h["health"] == "degraded"
+        comp = h["components"]["registry"]
+        assert comp["status"] == "degraded"
+        assert comp["scan_errors"] == {"broken.zip": "BadZipFile: corrupt"}
+    finally:
+        s.stop()
+
+
+# ------------------------------------------------------- frontend plumbing
+
+def test_frontend_rejects_misconfiguration():
+    with pytest.raises(ValueError):
+        FleetFrontend([])
+    with pytest.raises(ValueError):
+        FleetFrontend(["http://a:1", "http://b:1"], names=["one"])
+    with pytest.raises(ValueError):
+        FleetFrontend(["http://a:1", "http://b:1"], names=["x", "x"])
+
+
+def test_rollback_during_canary_transition_is_409_not_fleet_wide():
+    """A /rollback racing a canary's DEPLOYING/PROMOTING/ROLLING_BACK
+    broadcast must be rejected (409) — not reinterpreted as 'revert the
+    ENTIRE stable fleet to its previous version'."""
+    from deeplearning4j_tpu.serving import canary as canary_states
+    s1 = ServingServer(StubModel(), version="v1", port=0,
+                       alert_interval_s=0).start()
+    s2 = ServingServer(StubModel(), version="v1", port=0,
+                       alert_interval_s=0).start()
+    fe = FleetFrontend([s1.url, s2.url], names=["a", "b"],
+                       health_interval_s=1e9, alert_interval_s=0).start()
+    try:
+        for srv in (s1, s2):
+            srv.registry.register("v2", StubModel(3.0))
+            post_json(srv.url + "/deploy", {"version": "v2"}, timeout=30)
+        fe.canary.state = canary_states.DEPLOYING     # in-flight deploy POST
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            post_json(fe.url + "/rollback", {}, timeout=30)
+        assert ei.value.code == 409
+        # nobody was reverted
+        assert s1.registry.active_version == "v2"
+        assert s2.registry.active_version == "v2"
+        fe.canary.state = canary_states.IDLE
+        post_json(fe.url + "/rollback", {}, timeout=30)   # idle: fleet-wide
+        assert s1.registry.active_version == "v1"
+        assert s2.registry.active_version == "v1"
+    finally:
+        fe.stop()
+        s1.stop()
+        s2.stop()
+
+
+def test_registry_subscriber_applies_broker_fanned_events():
+    """Cross-host registry view: a deploy routed through the frontend fans
+    out over the streaming broker and a RegistrySubscriber applies it on a
+    host the frontend does not even route to."""
+    import time
+    from deeplearning4j_tpu.serving import RegistrySubscriber
+    from deeplearning4j_tpu.streaming import BrokerClient, MessageBroker
+    broker = MessageBroker(port=0, registry=MetricsRegistry()).start()
+    s1 = ServingServer(StubModel(), version="v1", port=0,
+                       alert_interval_s=0).start()
+    s2 = ServingServer(StubModel(), version="v1", port=0,
+                       alert_interval_s=0).start()
+    other = ServingServer(StubModel(), version="v1", port=0,
+                          alert_interval_s=0)     # never started: local only
+    other.registry.register("v2", StubModel(3.0))
+    pub = BrokerClient(port=broker.port)
+    sub_client = BrokerClient(port=broker.port)
+    sub = RegistrySubscriber(other, sub_client, poll_timeout_s=0.05).start()
+    fe = FleetFrontend([s1.url, s2.url], names=["a", "b"], broker=pub,
+                       health_interval_s=1e9, alert_interval_s=0).start()
+    try:
+        for srv in (s1, s2):
+            srv.registry.register("v2", StubModel(3.0))
+        res = post_json(fe.url + "/deploy", {"version": "v2"}, timeout=30)
+        assert res["version"] == "v2"
+        assert s1.registry.active_version == "v2"
+        assert s2.registry.active_version == "v2"
+        t0 = time.monotonic()
+        while other.registry.active_version != "v2":
+            assert time.monotonic() - t0 < 15.0, sub.errors
+            time.sleep(0.02)
+        assert sub.applied == 1 and sub.errors == []
+    finally:
+        fe.stop()
+        sub.close()
+        pub.close()
+        s1.stop()
+        s2.stop()
+        broker.stop()
+
+
+# -------------------------------------------------------------- acceptance
+
+def test_acceptance_replica_death_failover_breaker_recovery(manual_clock):
+    """ISSUE 8 acceptance: with one of two replicas fault-injected dead,
+    /predict error rate at the front-end stays 0 (failover), the dead
+    replica's breaker shows `open` in /fleet/metrics, and after recovery
+    the half-open probe restores two-replica routing — zero real sleeps."""
+    s1 = ServingServer(StubModel(), port=0, alert_interval_s=0).start()
+    s2 = ServingServer(StubModel(), port=0, alert_interval_s=0).start()
+    fe = FleetFrontend([s1.url, s2.url], names=["a", "b"],
+                       health_interval_s=1e9, breaker_min_calls=2,
+                       breaker_window=10, breaker_open_for_s=30.0,
+                       alert_interval_s=0).start()
+    fleet = FleetServer([fe.url], names=["frontend"], interval_s=0.0).start()
+    total = 0
+
+    def predict():
+        nonlocal total
+        total += 1
+        r = post_json(fe.url + "/predict", {"data": [[1.0, 2.0]]},
+                      timeout=30)
+        assert r["prediction"] == [[2.0, 4.0]], r
+        return r
+
+    try:
+        served = {predict()["replica"] for _ in range(4)}
+        assert served == {"a", "b"}              # both replicas in rotation
+
+        plan = FaultPlan([FaultRule("reset", match=s2.url + "/predict",
+                                    name="kill-b")])
+        with plan:
+            kill_phase = [predict() for _ in range(8)]
+            # failover kept every client answer a 200
+            assert all(r["prediction"] == [[2.0, 4.0]] for r in kill_phase)
+            assert all(r["replica"] == "a" for r in kill_phase[-4:])
+            assert any(r["attempts"] == 2 for r in kill_phase)  # failovers
+
+            snap = get_json(fe.url + "/metrics", timeout=30)
+            assert snap["replicas"]["b"]["breaker"]["state"] == "open"
+            assert snap["frontend_failovers_total"] >= 1
+            # the ejection is DATA on the fleet plane, not absence
+            fm = get_json(fleet.url + "/fleet/metrics", timeout=30)
+            inst = fm["instances"]["frontend"]
+            assert inst["breaker_state"]["replica=b"] == 2.0
+            assert inst["breaker_state"]["replica=a"] == 0.0
+            assert inst["replicas"]["b"]["breaker"]["state"] == "open"
+            fh = get_json(fleet.url + "/fleet/healthz", timeout=30)
+            assert fh["status"] == "degraded"    # visible, still serving
+            # the frontend itself: degraded replica probe, 200 /healthz
+            # (its OWN load balancer must not pull a serving front door)
+            code, h = get_json(fe.url + "/healthz", timeout=30,
+                               with_status=True)
+            assert code == 200 and h["health"] == "degraded"
+            assert h["components"]["replica:b"]["status"] == "degraded"
+            assert h["components"]["pool"]["status"] == "degraded"
+
+            # ---- recovery: kill switch off, cool-off elapses -------------
+            plan.set_active("kill-b", False)
+            r = predict()
+            assert r["replica"] == "a"           # breaker still open: no b
+            manual_clock.advance(31.0)           # cool-off on the clock
+            recovered = {predict()["replica"] for _ in range(6)}
+            assert recovered == {"a", "b"}       # half-open probe re-admitted
+            snap = get_json(fe.url + "/metrics", timeout=30)
+            assert snap["replicas"]["b"]["breaker"]["state"] == "closed"
+
+        # error rate at the front-end stayed 0 THROUGHOUT
+        snap = get_json(fe.url + "/metrics", timeout=30)
+        assert snap["frontend_requests_total"] == {"code=200": float(total)}
+        # breaker transitions were logged + counted
+        assert snap["breaker_transitions_total"]["replica=b,state=open"] \
+            == 1.0
+        logs = get_json(fe.url + "/logs", timeout=30)
+        msgs = [r["message"] for r in logs["records"]]
+        assert "breaker_transition" in msgs
+    finally:
+        fleet.stop()
+        fe.stop()
+        s1.stop()
+        s2.stop()
+
+
+def test_acceptance_bad_canary_rolls_back_without_client_5xx(manual_clock):
+    """ISSUE 8 acceptance: a canary version whose injected error ratio
+    breaches the SLO rule is auto-rolled-back, no 5xx ever reaches a
+    front-end client (failover serves the stable version throughout), and
+    the transition is visible in /alerts and trace-correlated /logs."""
+    s1 = ServingServer(StubModel(), version="v1", port=0,
+                       alert_interval_s=0).start()
+    s2 = ServingServer(StubModel(), version="v1", port=0,
+                       alert_interval_s=0).start()
+    s2.registry.register("v2", StubModel(3.0))
+    fe = FleetFrontend([s1.url, s2.url], names=["a", "b"],
+                       health_interval_s=1e9, breaker_min_calls=3,
+                       breaker_open_for_s=30.0, alert_interval_s=0,
+                       canary_opts={"bake_s": 120.0, "min_requests": 4,
+                                    "error_ratio": 0.25,
+                                    "window_s": 300.0}).start()
+    try:
+        res = post_json(fe.url + "/deploy",
+                        {"version": "v2", "canary": 0.5}, timeout=30)
+        assert res["canary"]["state"] == "observing"
+        assert res["canary"]["replica"] == "b"
+        assert s2.registry.active_version == "v2"
+        assert s1.registry.active_version == "v1"    # stable fleet untouched
+        fe.alerts.evaluate()                         # baseline window sample
+
+        plan = FaultPlan([FaultRule("error", match=s2.url + "/predict",
+                                    status=500, name="bad-canary")])
+        rollback_events = []
+        with plan:
+            for _ in range(8):
+                r = post_json(fe.url + "/predict", {"data": [[1.0, 2.0]]},
+                              timeout=30)
+                # every answer is the STABLE version's output: the canary
+                # attempt failed over to a stable replica
+                assert r["prediction"] == [[2.0, 4.0]], r
+            manual_clock.advance(5.0)
+            rollback_events = fe.alerts.evaluate()   # the gate fires -> react
+
+        fired = [e for e in rollback_events
+                 if e["rule"] == "canary_error_ratio"]
+        assert fired and fired[0]["state"] == "firing"
+        assert fired[0]["value"] > 0.25
+        assert fe.canary.state == "idle"
+        last = fe.canary.history[-1]
+        assert last["outcome"] == "rolled_back"
+        assert last["reason"] == "canary_error_ratio"
+        assert s2.registry.active_version == "v1"    # replica redeployed old
+        # zero 5xx reached clients
+        snap = get_json(fe.url + "/metrics", timeout=30)
+        assert set(snap["frontend_requests_total"]) == {"code=200"}
+        assert snap["canary_rollbacks_total"] == 1.0
+        # visible in /alerts ...
+        al = get_json(fe.url + "/alerts", timeout=30)
+        assert al["canary"]["rollbacks"] == 1
+        assert al["canary"]["history"][-1]["outcome"] == "rolled_back"
+        # ... and in /logs: the rollback event, plus trace-correlated
+        # failed-attempt records (each carries the request's trace id)
+        logs = get_json(fe.url + "/logs?level=error", timeout=30)
+        assert any(r["message"] == "canary_rolled_back"
+                   for r in logs["records"])
+        warns = get_json(fe.url + "/logs?level=warning", timeout=30)
+        failed = [r for r in warns["records"]
+                  if r["message"] == "predict_attempt_failed"]
+        assert failed and all(r.get("trace_id") for r in failed)
+        tr = get_json(fe.url + "/trace", timeout=30)
+        span_traces = {e["args"].get("trace_id")
+                       for e in tr["traceEvents"] if e.get("ph") == "X"}
+        assert failed[-1]["trace_id"] in span_traces
+    finally:
+        fe.stop()
+        s1.stop()
+        s2.stop()
+
+
+def test_acceptance_healthy_canary_auto_promotes(manual_clock):
+    """The other gate outcome: a canary that bakes healthy for bake_s with
+    enough traffic auto-promotes to the whole fleet."""
+    s1 = ServingServer(StubModel(), version="v1", port=0,
+                       alert_interval_s=0).start()
+    s2 = ServingServer(StubModel(), version="v1", port=0,
+                       alert_interval_s=0).start()
+    for srv in (s1, s2):
+        srv.registry.register("v2", StubModel(3.0))
+    fe = FleetFrontend([s1.url, s2.url], names=["a", "b"],
+                       health_interval_s=1e9, alert_interval_s=0,
+                       canary_opts={"bake_s": 60.0, "min_requests": 3,
+                                    "error_ratio": 0.5,
+                                    "window_s": 300.0}).start()
+    promote_events = []
+    fe.alerts.add_sink(promote_events.append)
+    try:
+        post_json(fe.url + "/deploy", {"version": "v2", "canary": 0.5},
+                  timeout=30)
+        fe.alerts.evaluate()
+        outputs = set()
+        for _ in range(8):
+            r = post_json(fe.url + "/predict", {"data": [[1.0, 2.0]]},
+                          timeout=30)
+            outputs.add(r["prediction"][0][0])
+        assert outputs == {2.0, 3.0}          # both cohorts actually served
+        manual_clock.advance(30.0)
+        fe.alerts.evaluate()
+        assert fe.canary.state == "observing"  # still baking: no promote
+        manual_clock.advance(31.0)
+        fe.alerts.evaluate()
+        assert fe.canary.state == "idle"
+        assert fe.canary.history[-1]["outcome"] == "promoted"
+        assert s1.registry.active_version == "v2"   # fleet-wide now
+        assert s2.registry.active_version == "v2"
+        assert any(e["rule"] == "canary_promote_ready"
+                   and e["state"] == "firing" for e in promote_events)
+        al = get_json(fe.url + "/alerts", timeout=30)
+        assert al["canary"]["promotions"] == 1
+        logs = get_json(fe.url + "/logs", timeout=30)
+        assert any(r["message"] == "canary_promoted"
+                   for r in logs["records"])
+    finally:
+        fe.stop()
+        s1.stop()
+        s2.stop()
+
+
+def test_failed_rollback_keeps_bad_version_out_of_stable_rotation(
+        manual_clock):
+    """If the rollback POST cannot land (canary replica unreachable right
+    when its bad version must come off), the replica must NOT silently
+    rejoin the stable pool still serving the bad version: it stays in the
+    (idle, zero-fraction) canary cohort — failover target only — a new
+    canary over the wreckage is refused, and a fleet-wide /deploy
+    re-admits it."""
+    s1 = ServingServer(StubModel(), version="v1", port=0,
+                       alert_interval_s=0).start()
+    s2 = ServingServer(StubModel(), version="v1", port=0,
+                       alert_interval_s=0).start()
+    for srv in (s1, s2):
+        srv.registry.register("v2", StubModel(3.0))
+    fe = FleetFrontend([s1.url, s2.url], names=["a", "b"],
+                       health_interval_s=1e9, breaker_min_calls=100,
+                       alert_interval_s=0,
+                       canary_opts={"bake_s": 120.0, "min_requests": 4,
+                                    "error_ratio": 0.25,
+                                    "window_s": 300.0}).start()
+    try:
+        post_json(fe.url + "/deploy", {"version": "v2", "canary": 0.5},
+                  timeout=30)
+        fe.alerts.evaluate()
+        # the canary predicts fail AND its /rollback endpoint is dead too
+        plan = FaultPlan([
+            FaultRule("error", match=s2.url + "/predict", status=500,
+                      name="bad-canary"),
+            FaultRule("reset", match=s2.url + "/rollback", name="dead-b")])
+        with plan:
+            for _ in range(8):
+                post_json(fe.url + "/predict", {"data": [[1.0, 2.0]]},
+                          timeout=30)
+            manual_clock.advance(5.0)
+            fe.alerts.evaluate()             # breach fires -> rollback fails
+        last = fe.canary.history[-1]
+        assert last["outcome"] == "rolled_back"
+        assert last["undeployed"] is False
+        assert s2.registry.active_version == "v2"    # bad version still up
+        assert fe.canary.state == "idle"
+        # ... but b is NOT back in the stable rotation: primary traffic
+        # goes to a only (b remains a failover target)
+        assert fe._replica("b").cohort == "canary"
+        assert {post_json(fe.url + "/predict", {"data": [[1.0, 2.0]]},
+                          timeout=30)["replica"] for _ in range(6)} == {"a"}
+        # the failure is loud: logged + broker-visible history entry
+        logs = get_json(fe.url + "/logs?level=error", timeout=30)
+        assert any(r["message"] == "canary_rollback_failed"
+                   for r in logs["records"])
+        # a new canary over the wreckage is refused
+        with pytest.raises(urllib.error.HTTPError):
+            post_json(fe.url + "/deploy", {"version": "v2", "canary": 0.5},
+                      timeout=30)
+        # fleet-wide deploy re-admits b with the fleet version
+        post_json(fe.url + "/deploy", {"version": "v1"}, timeout=30)
+        assert s2.registry.active_version == "v1"
+        assert fe._replica("b").cohort == "stable"
+        served = {post_json(fe.url + "/predict", {"data": [[1.0, 2.0]]},
+                            timeout=30)["replica"] for _ in range(6)}
+        assert served == {"a", "b"}
+    finally:
+        fe.stop()
+        s1.stop()
+        s2.stop()
+
+
+def test_back_to_back_canaries_do_not_inherit_prior_errors(manual_clock):
+    """A healthy canary started right after a rolled-back one (inside the
+    SLO rule's window_s) must promote, not roll back: the engine's windowed
+    counter history for the reused cohort label-set is dropped at canary
+    start, so the new rule windows only THIS deploy's traffic."""
+    s1 = ServingServer(StubModel(), version="v1", port=0,
+                       alert_interval_s=0).start()
+    s2 = ServingServer(StubModel(), version="v1", port=0,
+                       alert_interval_s=0).start()
+    for srv in (s1, s2):
+        srv.registry.register("v2", StubModel(3.0))
+    fe = FleetFrontend([s1.url, s2.url], names=["a", "b"],
+                       health_interval_s=1e9, breaker_min_calls=100,
+                       alert_interval_s=0,
+                       canary_opts={"bake_s": 60.0, "min_requests": 3,
+                                    "error_ratio": 0.25,
+                                    "window_s": 300.0}).start()
+    try:
+        # ---- canary 1: injected errors -> rolled back --------------------
+        post_json(fe.url + "/deploy", {"version": "v2", "canary": 0.5},
+                  timeout=30)
+        fe.alerts.evaluate()
+        plan = FaultPlan([FaultRule("error", match=s2.url + "/predict",
+                                    status=500, name="bad")])
+        with plan:
+            for _ in range(8):
+                post_json(fe.url + "/predict", {"data": [[1.0, 2.0]]},
+                          timeout=30)
+            manual_clock.advance(5.0)
+            fe.alerts.evaluate()
+        assert fe.canary.history[-1]["outcome"] == "rolled_back"
+        assert s2.registry.active_version == "v1"
+
+        # ---- canary 2, healthy, started well inside window_s -------------
+        manual_clock.advance(10.0)
+        post_json(fe.url + "/deploy", {"version": "v2", "canary": 0.5},
+                  timeout=30)
+        fe.alerts.evaluate()          # must NOT see canary 1's error deltas
+        assert fe.canary.state == "observing", fe.canary.history[-1]
+        for _ in range(8):
+            post_json(fe.url + "/predict", {"data": [[1.0, 2.0]]},
+                      timeout=30)
+        manual_clock.advance(61.0)    # bake elapses; still within window_s
+        fe.alerts.evaluate()
+        assert fe.canary.history[-1]["outcome"] == "promoted", \
+            fe.canary.history[-1]
+        assert s1.registry.active_version == "v2"
+        assert s2.registry.active_version == "v2"
+    finally:
+        fe.stop()
+        s1.stop()
+        s2.stop()
+
+
+def test_acceptance_failed_over_request_is_one_trace():
+    """ISSUE 8 acceptance: a retried/failed-over request appears as ONE
+    trace — front-end server span -> per-attempt child spans with retry
+    attributes -> the winning replica's server span — via /fleet/trace."""
+    s1 = ServingServer(StubModel(), port=0, alert_interval_s=0).start()
+    s2 = ServingServer(StubModel(), port=0, alert_interval_s=0).start()
+    fe = FleetFrontend([s1.url, s2.url], names=["a", "b"],
+                       health_interval_s=1e9, breaker_min_calls=100,
+                       alert_interval_s=0).start()
+    fleet = FleetServer([fe.url, s1.url], names=["frontend", "a"],
+                        interval_s=0.0).start()
+    client = Tracer(enabled=True)
+    try:
+        plan = FaultPlan([FaultRule("reset", match=s2.url + "/predict",
+                                    name="kill-b")])
+        failover_trace = None
+        with plan:
+            for _ in range(6):
+                with client.span("client_call") as cs:
+                    r = post_json(fe.url + "/predict",
+                                  {"data": [[1.0, 2.0]]}, timeout=30)
+                if r["attempts"] == 2 and r["replica"] == "a":
+                    failover_trace = cs.trace_id
+                    break
+        assert failover_trace, "no request failed over b -> a"
+
+        # frontend side: server span -> frontend_predict -> two attempts
+        tr = get_json(fe.url + "/trace", timeout=30)
+        spans = [e for e in tr["traceEvents"] if e.get("ph") == "X"
+                 and e["args"].get("trace_id") == failover_trace]
+        by_name = {}
+        for e in spans:
+            by_name.setdefault(e["name"], []).append(e)
+        server = by_name["http /predict"][0]
+        root = by_name["frontend_predict"][0]
+        attempts = sorted(by_name["attempt"],
+                          key=lambda e: e["args"]["attempt"])
+        assert root["args"]["parent_id"] == server["args"]["span_id"]
+        assert len(attempts) == 2
+        assert [a["args"]["retry"] for a in attempts] == [False, True]
+        assert [a["args"]["replica"] for a in attempts] == ["b", "a"]
+        assert attempts[0]["args"]["error"] == "ConnectionResetError"
+        for a in attempts:
+            assert a["args"]["parent_id"] == root["args"]["span_id"]
+
+        # winning replica side: ITS server span continues the same trace,
+        # parented on the winning attempt
+        atr = get_json(s1.url + "/trace", timeout=30)
+        aspans = [e for e in atr["traceEvents"] if e.get("ph") == "X"
+                  and e["args"].get("trace_id") == failover_trace]
+        anames = {e["name"] for e in aspans}
+        assert {"http /predict", "predict"} <= anames, anames
+        aserver = next(e for e in aspans if e["name"] == "http /predict")
+        assert aserver["args"]["parent_id"] == \
+            attempts[1]["args"]["span_id"]
+
+        # and the fleet plane shows the whole thing across both hosts
+        ftr = get_json(fleet.url + "/fleet/trace", timeout=30)
+        lanes_with_trace = {e["pid"] for e in ftr["traceEvents"]
+                            if e.get("ph") == "X"
+                            and e["args"].get("trace_id") == failover_trace}
+        assert lanes_with_trace == {0, 1}
+    finally:
+        fleet.stop()
+        fe.stop()
+        s1.stop()
+        s2.stop()
+
+
+def test_smoke_chaos_tool():
+    """Fast variant of tools/smoke_chaos.py: kill/recover failover plus a
+    canary rollback end-to-end in one run."""
+    import tools.smoke_chaos as smoke
+    out = smoke.run(n_requests=6)
+    assert out["kill_phase_errors"] == 0
+    assert out["breaker_opened"] is True
+    assert out["recovered_replicas"] == ["a", "b"]
+    assert out["canary_outcome"] == "rolled_back"
+    assert out["client_5xx"] == 0

@@ -86,6 +86,16 @@ import numpy as np
 def worker_loop(chunk):
     return np.asarray(chunk, np.float32)
 """, [4]),
+    "GL009": ("""\
+import time
+
+def fetch(url, tries):
+    for attempt in range(tries):
+        try:
+            return url
+        except OSError:
+            time.sleep(attempt + 1.0)
+""", [8]),
 }
 
 
@@ -405,6 +415,93 @@ def test_gl008_repo_choke_point_holds():
         ["deeplearning4j_tpu/datasets/fetchers/download.py"]
 
 
+def test_gl009_retry_tell_vs_pacing_and_allowlist():
+    # while-form fires too; the except handler is what makes it a retry
+    retry_while = ("""\
+import time
+
+def deliver(msg):
+    while True:
+        try:
+            return send(msg)
+        except ConnectionError:
+            time.sleep(0.5)
+""")
+    assert [(v.rule, v.line) for v in lint(retry_while, rules=["GL009"])] \
+        == [("GL009", 8)]
+    # a sleep that merely paces a loop (no except handler) is not a retry
+    pacing = ("""\
+import time
+
+def watch(stop):
+    while not stop.is_set():
+        time.sleep(0.25)
+""")
+    assert lint(pacing, rules=["GL009"]) == []
+    # a sleep in a nested def is that function's business, not the loop's
+    nested = ("""\
+import time
+
+def build(jobs):
+    for j in jobs:
+        def backoff():
+            try:
+                return j()
+            except OSError:
+                time.sleep(1.0)
+        yield backoff
+""")
+    assert lint(nested, rules=["GL009"]) == []
+    # the mirror case: a PACING sleep in the loop body next to a callback
+    # definition that catches its own errors — the handler belongs to the
+    # nested scope, so the loop is not a retry loop
+    pacing_with_cb = ("""\
+import time
+
+def schedule(jobs, submit):
+    for j in jobs:
+        def cb():
+            try:
+                return j()
+            except OSError:
+                pass
+        submit(cb)
+        time.sleep(0.25)
+""")
+    assert lint(pacing_with_cb, rules=["GL009"]) == []
+    # a poller that catches an UNRELATED condition and paces outside the
+    # handler is not retrying either: the sleep must live IN the handler
+    poller = ("""\
+import time
+import queue
+
+def drain(q, stop):
+    while not stop.is_set():
+        try:
+            q.get_nowait()
+        except queue.Empty:
+            pass
+        time.sleep(0.1)
+""")
+    assert lint(poller, rules=["GL009"]) == []
+    # the policy implementation itself is the one allowed home
+    src, _ = SEEDS["GL009"]
+    assert lint(src,
+                rel_path="deeplearning4j_tpu/resilience/policy.py",
+                rules=["GL009"]) == []
+
+
+def test_gl009_repo_has_no_raw_retry_loops():
+    """Satellite gate: every ad-hoc retry loop (broker reconnect, remote
+    stats router, dataset download) was migrated to resilience.RetryPolicy;
+    nothing may hand-roll a new one silently."""
+    report = Analyzer(rules=[get_rule("GL009")], root=str(REPO)).analyze_paths(
+        ["deeplearning4j_tpu", "tools"])
+    assert report.errors == []
+    new, matched = Baseline.load(str(BASELINE_PATH)).split(report.violations)
+    assert new == [] and matched == []
+
+
 # ---------------------------------------------------------------- baseline
 
 def test_baseline_round_trip_via_cli(tmp_path):
@@ -535,7 +632,7 @@ def test_cli_rule_subset_and_list_rules():
         assert rule.id in proc.stdout and rule.rationale
     assert [r.id for r in all_rules()] == \
         ["GL001", "GL002", "GL003", "GL004", "GL005", "GL006", "GL007",
-         "GL008"]
+         "GL008", "GL009"]
 
 
 def test_repo_gate_is_clean_and_fast():
