@@ -706,11 +706,11 @@ from deeplearning4j_tpu.zoo.models import mlp_mnist
 from deeplearning4j_tpu.datasets.dataset import DataSet
 from deeplearning4j_tpu.parallel.sharding import ShardedTrainer, make_mesh
 
-def run(n_dev, batch, steps=20):
+def run(n_dev, batch, steps=20, zero=False):
     net = mlp_mnist(hidden=1024)
     net.init()
     mesh = make_mesh(n_data=n_dev, devices=jax.devices()[:n_dev])
-    tr = ShardedTrainer(net, mesh=mesh)
+    tr = ShardedTrainer(net, mesh=mesh, shard_update=zero)
     rng = np.random.default_rng(0)
     x = rng.random((batch, 784)).astype(np.float32)
     y = np.eye(10, dtype=np.float32)[rng.integers(0, 10, batch)]
@@ -728,6 +728,34 @@ def run(n_dev, batch, steps=20):
 sps_1, compile_1 = run(1, 512)
 sps_8s, compile_8 = run(8, 512)
 sps_8w, _ = run(8, 4096)
+
+# ZeRO-1 sharded update (parallel/zero.py, ROADMAP item 4): step-time guard
+# on the same fixed workload (all 8 virtual devices share ONE physical CPU,
+# so the per-shard update does the same total arithmetic — the ratio
+# isolates the reduce-scatter/all-gather overhead the transform adds), and
+# per-device state bytes for the HEADLINE model (resnet50 + Nesterovs
+# momentum, the BENCH config #2 updater) replicated vs sharded.
+zero_step_ratio = zero_bytes = None
+try:
+    sps_8z, _ = run(8, 512, zero=True)
+    zero_step_ratio = sps_8s / sps_8z    # >1: the ZeRO step is SLOWER
+    from deeplearning4j_tpu.zoo.models import resnet50
+    from deeplearning4j_tpu.parallel.zero import ZeroUpdater, per_device_bytes
+    from deeplearning4j_tpu.nn.updaters import Nesterovs
+    rn = resnet50(num_classes=1000, image_size=32,
+                  updater=Nesterovs(learning_rate=0.05, momentum=0.9))
+    rn.init()   # state bytes depend on params only, not image size/batch
+    repl_opt = per_device_bytes(rn.opt_state)
+    param_b = per_device_bytes(rn.params)
+    zu = ZeroUpdater(make_mesh(n_data=8))
+    sharded_opt = per_device_bytes(zu.from_canonical(rn.opt_state, rn.params))
+    zero_bytes = {"opt_state_bytes_per_device_replicated": repl_opt,
+                  "opt_state_bytes_per_device": sharded_opt,
+                  "param_bytes_per_device": param_b,
+                  "zero_state_reduction_x": repl_opt / max(sharded_opt, 1)}
+except Exception as e:
+    import sys as _sys
+    print(f"zero sharded-update bench failed: {e}", file=_sys.stderr)
 
 # pipeline 1F1B: wall of the async-enqueued schedule vs the same compiled
 # stage executables host-fenced after every op (<1.0 = stages overlap).
@@ -784,7 +812,9 @@ print(json.dumps({
     "compile_s_1dev": compile_1, "compile_s_8dev": compile_8,
     "pipeline_overlap_ratio": pipe_ratio,
     "pipeline_bubble_fraction": pipe_bubble,
-    "pipeline_bubble_ideal": pipe_ideal}))
+    "pipeline_bubble_ideal": pipe_ideal,
+    "zero_step_ratio": zero_step_ratio,
+    "zero_bytes": zero_bytes}))
 """
     env = dict(os.environ)
     env.pop("JAX_PLATFORMS", None)
@@ -955,6 +985,26 @@ def main():
                 if r.get("pipeline_bubble_ideal") is not None:
                     extras["pipeline_bubble_ideal"] = round(
                         r["pipeline_bubble_ideal"], 3)
+                # ZeRO-1 sharded update: the state reduction as a measured
+                # number on the headline model, plus the step-time guard
+                if r.get("zero_step_ratio") is not None:
+                    extras["zero_step_ratio"] = round(r["zero_step_ratio"], 2)
+                    extras["zero_step_note"] = (
+                        "sharded-update wall / replicated-update wall on the"
+                        " 8-virtual-device mesh (one shared CPU: per-shard"
+                        " update work doesn't shrink here, so ~1.0 = the"
+                        " added collectives are free; real meshes also cut"
+                        " the update FLOPs 8x)")
+                zb = r.get("zero_bytes")
+                if zb:
+                    extras["opt_state_bytes_per_device"] = int(
+                        zb["opt_state_bytes_per_device"])
+                    extras["opt_state_bytes_per_device_replicated"] = int(
+                        zb["opt_state_bytes_per_device_replicated"])
+                    extras["param_bytes_per_device"] = int(
+                        zb["param_bytes_per_device"])
+                    extras["zero_state_reduction_x"] = round(
+                        zb["zero_state_reduction_x"], 2)
         except Exception as e:
             print(f"{name} bench failed: {type(e).__name__}: {e}",
                   file=sys.stderr)
@@ -967,6 +1017,15 @@ def main():
     }
     out.update(extras)
     out["regressions"] = _regressions_vs_prior(out)
+    # ZeRO guard: the sharded update must not slow the step down at 8
+    # virtual devices (10% margin over shared-core scheduler noise)
+    zr = extras.get("zero_step_ratio")
+    if isinstance(zr, (int, float)) and zr > 1.1:
+        out["regressions"].append(
+            {"metric": "zero_step_ratio", "best_prior": 1.0,
+             "now": round(float(zr), 2),
+             "detail": "ZeRO-sharded step slower than replicated at 8 "
+                       "virtual devices"})
     donation = [str(w.message).splitlines()[0] for w in _caught
                 if "donated buffers were not usable" in str(w.message)]
     _warn_net.__exit__(None, None, None)

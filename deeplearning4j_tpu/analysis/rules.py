@@ -1,4 +1,4 @@
-"""GL001–GL009: the rule catalog (see RULES.md for the bug-history rationale).
+"""GL001–GL010: the rule catalog (see RULES.md for the bug-history rationale).
 
 Each rule is intra-file AST analysis with light import resolution: aliases
 from ``import x as y`` / ``from m import n as y`` are resolved so
@@ -704,3 +704,81 @@ class RawRetryLoopRule(Rule):
                                   ast.Lambda)):
                 return False
         return False
+
+
+# ---------------------------------------------------------------------------
+# GL010 — jit-missing-donation
+# ---------------------------------------------------------------------------
+
+@register
+class JitMissingDonationRule(Rule):
+    """jax.jit over a params/opt_state-taking step without donate_argnums."""
+
+    id = "GL010"
+    name = "jit-missing-donation"
+    rationale = (
+        "The headline train step sits at the HBM roofline "
+        "(BENCH_r05 roofline_util~1.0): without donate_argnums the XLA "
+        "executable allocates FRESH output buffers for params and updater "
+        "state every step — double the state bytes resident and an extra "
+        "full copy of HBM traffic, i.e. milliseconds per step. Every "
+        "train-step jit in the nn/ and parallel/ hot modules must donate "
+        "its params/opt_state arguments (the functional analog of the "
+        "reference's in-place flattened param view). Inference jits that "
+        "take `params` but must NOT donate them (the same buffers serve "
+        "every call) are deliberate remainders — baseline them with a "
+        "note.")
+
+    HOT_DIRS = ("deeplearning4j_tpu/nn/", "deeplearning4j_tpu/parallel/")
+    STATE_ARGS = frozenset({"params", "opt_state"})
+
+    def check(self, ctx):
+        if not ctx.rel_path.startswith(self.HOT_DIRS):
+            return
+        aliases = ctx.aliases
+        defs = {}
+        for node in ctx.nodes:
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                defs.setdefault(node.name, node)
+        for node in ctx.nodes:
+            # call form: jax.jit(step_fn, ...) — resolve a Name argument to
+            # its def in this file (the repo idiom: def then jit) or an
+            # inline lambda; opaque expressions stay quiet (shallow-and-
+            # sound-enough, like every rule here)
+            if isinstance(node, ast.Call) \
+                    and qualname(node.func, aliases) == "jax.jit" \
+                    and not self._donates(node):
+                target = node.args[0] if node.args else None
+                fn = None
+                if isinstance(target, ast.Name):
+                    fn = defs.get(target.id)
+                elif isinstance(target, ast.Lambda):
+                    fn = target
+                if fn is not None and self._takes_state(fn):
+                    yield self.violation(
+                        ctx, node,
+                        "jax.jit over a params/opt_state-taking function "
+                        "without donate_argnums: the step pays a fresh "
+                        "state-sized allocation + copy every call; donate "
+                        "the state args (or baseline an inference jit "
+                        "with a note)")
+            # decorator form: @jax.jit above a params/opt_state-taking def
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                for dec in node.decorator_list:
+                    if qualname(dec, aliases) == "jax.jit" \
+                            and self._takes_state(node):
+                        yield self.violation(
+                            ctx, node,
+                            f"@jax.jit on `{node.name}({', '.join(a.arg for a in node.args.args)})` "
+                            "cannot pass donate_argnums: use "
+                            "jax.jit(fn, donate_argnums=...) so the "
+                            "params/opt_state buffers alias in place")
+
+    @staticmethod
+    def _donates(call):
+        return any(kw.arg == "donate_argnums" for kw in call.keywords)
+
+    @classmethod
+    def _takes_state(cls, fn):
+        names = {a.arg for a in fn.args.args + fn.args.kwonlyargs}
+        return bool(names & cls.STATE_ARGS)

@@ -47,6 +47,7 @@ class ComputationGraph(MultiStepTrainable):
         self._jit_cache = {}
         self._rnn_state = {}
         self._ingest = None         # device-side ingest fused into the step
+        self._zero = None           # ZeRO-1 sharded update (parallel/zero.py)
 
     @property
     def score_value(self):
@@ -97,12 +98,13 @@ class ComputationGraph(MultiStepTrainable):
         return self
 
     def _build_updater(self, init_state=True):
-        from ..updaters import per_layer_transform
-        transforms = {}
-        for name in self.params:
-            lc = self.conf.vertices[name].layer_conf
-            transforms[name] = lc.updater.to_optax() if lc.updater is not None else optax.sgd(0.1)
-        self._tx = per_layer_transform(transforms)
+        from ..updaters import layer_transform, per_layer_transform
+        transforms = {name: layer_transform(self.conf.vertices[name].layer_conf)
+                      for name in self.params}
+        if self._zero is not None:
+            self._tx = self._zero.wrap(transforms, self.params)
+        else:
+            self._tx = per_layer_transform(transforms)
         if init_state:
             self.opt_state = self._tx.init(self.params)
 

@@ -54,6 +54,7 @@ class MultiLayerNetwork(MultiStepTrainable):
         self._rnn_state = {}        # streaming inference carries per layer idx
         self._jit_cache = {}
         self._ingest = None         # device-side ingest fused into the step
+        self._zero = None           # ZeRO-1 sharded update (parallel/zero.py)
 
     @property
     def score_value(self):
@@ -97,12 +98,16 @@ class MultiLayerNetwork(MultiStepTrainable):
 
     def _build_updater(self, init_state=True):
         """Per-layer optax transforms (each layer may override the updater —
-        reference: LayerUpdater per layer, UpdaterCreator)."""
-        from ..updaters import per_layer_transform
-        transforms = {}
-        for i, lc in enumerate(self.conf.layers):
-            transforms[str(i)] = lc.updater.to_optax() if lc.updater is not None else optax.sgd(0.1)
-        self._tx = per_layer_transform(transforms)
+        reference: LayerUpdater per layer, UpdaterCreator). With a ZeRO-1
+        updater installed (set_update_sharding), the per-layer transforms
+        wrap into the sharded-update transform instead."""
+        from ..updaters import layer_transform, per_layer_transform
+        transforms = {str(i): layer_transform(lc)
+                      for i, lc in enumerate(self.conf.layers)}
+        if self._zero is not None:
+            self._tx = self._zero.wrap(transforms, self.params)
+        else:
+            self._tx = per_layer_transform(transforms)
         if init_state:
             self.opt_state = self._tx.init(self.params)
 
@@ -687,13 +692,16 @@ class MultiLayerNetwork(MultiStepTrainable):
         lp = self.params[str(idx)]
         opt_state = tx.init(lp)
 
-        @jax.jit
         def pstep(lp, opt_state, rng, feats):
             def loss_fn(p):
                 return layer.pretrain_loss(p, feats, rng)
             loss, grads = jax.value_and_grad(loss_fn)(lp)
             updates, opt_state = tx.update(grads, opt_state, lp)
             return optax.apply_updates(lp, updates), opt_state, loss
+        # the layer params + updater state rebind every call, so their
+        # buffers alias in place instead of a fresh allocation per batch
+        # (GL010 — same contract as the main train steps)
+        pstep = jax.jit(pstep, donate_argnums=(0, 1))
 
         it = as_iterator(data)
         for _ in range(epochs):

@@ -38,6 +38,30 @@ import optax
 
 
 class MultiStepTrainable:
+    def set_update_sharding(self, zero):
+        """Install (or with None, remove) a ZeRO-1 sharded update
+        (parallel.zero.ZeroUpdater): updater state and the parameter update
+        partition over the mesh's data axis — reduce-scatter grads,
+        per-shard optax update, all-gather fresh params into the forward
+        (arXiv 2004.13336; ROADMAP item 4). Existing updater state carries
+        over exactly (canonical<->sharded conversion), so enabling,
+        resuming from a checkpoint, or changing replica count never resets
+        momentum. Clears the jit cache so every train path — including the
+        scanned multi-step executables this mixin owns — re-traces with the
+        sharded update fused. Shared by MultiLayerNetwork and
+        ComputationGraph (each contributes its own _build_updater)."""
+        old = self._zero
+        if old is not None and self.opt_state is not None:
+            self.opt_state = old.to_canonical(self.opt_state, self.params)
+        self._zero = zero
+        if self.params is not None:
+            self._build_updater(init_state=False)
+            if zero is not None and self.opt_state is not None:
+                self.opt_state = zero.from_canonical(self.opt_state,
+                                                     self.params)
+        self._jit_cache.clear()
+        return self
+
     def _make_multi_step(self):
         tx = self._tx
 

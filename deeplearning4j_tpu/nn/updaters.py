@@ -195,6 +195,15 @@ class NoOp(BaseUpdater):
         return optax.set_to_zero()
 
 
+def layer_transform(layer_conf):
+    """The optax transform for one layer conf — the layer's own updater, or
+    the reference's default plain SGD(0.1) when none is set. The single
+    construction point MultiLayerNetwork, ComputationGraph, and the ZeRO-1
+    sharded-update wrapper (parallel/zero.py) all build from."""
+    return layer_conf.updater.to_optax() if layer_conf.updater is not None \
+        else optax.sgd(0.1)
+
+
 def per_layer_transform(transforms: dict):
     """Top-level-partitioned optimizer: transforms[name] updates only
     params[name]'s subtree.

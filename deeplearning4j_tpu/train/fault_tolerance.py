@@ -74,6 +74,19 @@ class FaultTolerantTrainer:
         self.state = {"epoch": 0, "batch": 0, "iteration": 0, "rng": None}
         self._restored = self._try_restore()
 
+    def _net(self):
+        """The serializable network under self.model. A trainer wrapper
+        (ShardedTrainer — incl. ZeRO mode — exposes the wrapped network as
+        `.model` and drives it via fit_batch) checkpoints its INNER network;
+        a bare network is itself. Wrapper checkpoints therefore stay plain
+        ModelSerializer zips / orbax stores, loadable anywhere."""
+        m = self.model
+        inner = getattr(m, "model", None)
+        if inner is not None and hasattr(inner, "conf") \
+                and callable(getattr(m, "fit_batch", None)):
+            return inner
+        return m
+
     # ------------------------------------------------------------ checkpoint
     def _ckpt_dirs(self):
         out = []
@@ -119,16 +132,21 @@ class FaultTolerantTrainer:
         tmp = os.path.join(self.ckpt.directory, f"tmp-{it:09d}")
         os.makedirs(tmp, exist_ok=True)
         try:
+            net = self._net()
             if self.ckpt.format == "sharded":
                 from ..util.sharded_checkpoint import save_sharded
-                save_sharded(self.model, os.path.join(tmp, self.SHARDED_DIR))
+                save_sharded(net, os.path.join(tmp, self.SHARDED_DIR))
             else:
-                ModelSerializer.write_model(self.model,
+                ModelSerializer.write_model(net,
                                             os.path.join(tmp, self.MODEL_FILE))
             if jax.process_index() != 0:
                 return final  # process 0 publishes the checkpoint dir
             st = dict(self.state)
-            rng = getattr(self.model, "_rng", None)
+            # wrapper-ness persists so a restore only pays a factory build
+            # (and adopt) when the checkpointed run actually used one; plain
+            # networks restore without ever constructing a throwaway model
+            st["wrapper"] = self.model is not self._net()
+            rng = getattr(net, "_rng", None)
             st["rng"] = None if rng is None else np.asarray(rng).tolist()
             with open(os.path.join(tmp, self.STATE_FILE), "w") as f:
                 json.dump(st, f)
@@ -156,25 +174,38 @@ class FaultTolerantTrainer:
         dirs = self._ckpt_dirs()
         if not dirs:
             self.model = self._factory()
-            if getattr(self.model, "params", None) is None:
-                self.model.init()
+            if getattr(self._net(), "params", None) is None:
+                self._net().init()
             return False
         latest = os.path.join(self.ckpt.directory, dirs[-1])
         sharded_dir = os.path.join(latest, self.SHARDED_DIR)
-        if os.path.isdir(sharded_dir):
-            from ..util.sharded_checkpoint import restore_sharded
-            self.model = restore_sharded(sharded_dir)
-        else:
-            self.model = ModelSerializer.restore(
-                os.path.join(latest, self.MODEL_FILE))
         with open(os.path.join(latest, self.STATE_FILE)) as f:
             self.state = json.load(f)
+        if os.path.isdir(sharded_dir):
+            from ..util.sharded_checkpoint import restore_sharded
+            restored = restore_sharded(sharded_dir)
+        else:
+            restored = ModelSerializer.restore(
+                os.path.join(latest, self.MODEL_FILE))
+        self.model = restored
+        if self.state.get("wrapper"):
+            # the checkpointed run drove a trainer wrapper (ShardedTrainer):
+            # rebuild it via the factory — its mesh/ZeRO config reflects
+            # THIS process's topology — and adopt the restored network state
+            # (canonical updater state re-shards for the current replica
+            # count). Plain-network checkpoints never pay this factory build.
+            candidate = self._factory()
+            if getattr(candidate, "model", None) is not None \
+                    and callable(getattr(candidate, "adopt", None)):
+                candidate.adopt(restored)
+                self.model = candidate
+        net = self._net()
         rng = self.state.get("rng")
         if rng is not None:
             import jax.numpy as jnp
-            self.model._rng = jnp.asarray(np.asarray(rng, dtype=np.uint32))
-        self.model.iteration_count = self.state["iteration"]
-        self.model.epoch_count = self.state["epoch"]
+            net._rng = jnp.asarray(np.asarray(rng, dtype=np.uint32))
+        net.iteration_count = self.state["iteration"]
+        net.epoch_count = self.state["epoch"]
         return True
 
     @property
@@ -189,7 +220,7 @@ class FaultTolerantTrainer:
         checkpoints once more and raises TrainingHalted."""
         from ..datasets.iterator.base import as_iterator
         it = as_iterator(iterator)
-        listeners = getattr(self.model, "listeners", None)
+        listeners = getattr(self._net(), "listeners", None)
         if self.health is not None and listeners is not None \
                 and self.health not in listeners:
             listeners.append(self.health)

@@ -93,8 +93,16 @@ class ModelSerializer:
             if model.states:
                 zf.writestr(STATE_ENTRY, _tree_to_npz_bytes(model.states))
             if save_updater and model.opt_state is not None:
-                # optax states are namedtuple pytrees: store leaves positionally
-                leaves = jax.tree_util.tree_leaves(model.opt_state)
+                # optax states are namedtuple pytrees: store leaves positionally.
+                # ZeRO-sharded updater state (parallel/zero.py) is converted
+                # to its CANONICAL per-param layout first, so the zip stays
+                # topology-independent: it restores into an unsharded model
+                # or re-shards for any replica count.
+                opt_state = model.opt_state
+                zero = getattr(model, "_zero", None)
+                if zero is not None:
+                    opt_state = zero.to_canonical(opt_state, model.params)
+                leaves = jax.tree_util.tree_leaves(opt_state)
                 arrs = {f"leaf{i}": np.asarray(l) for i, l in enumerate(leaves)}
                 buf = io.BytesIO()
                 np.savez(buf, **arrs)

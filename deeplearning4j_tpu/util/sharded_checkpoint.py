@@ -63,8 +63,16 @@ def save_sharded(model, path):
                        "conf": model.conf.to_dict(),
                        "sharding": _sharding_meta(model.params)}, f)
     ckptr = ocp.StandardCheckpointer()
+    opt_state = model.opt_state
+    zero = getattr(model, "_zero", None)
+    if zero is not None:
+        # canonical per-param layout: the stored treedef matches the plain
+        # per_layer_transform state a restore template builds, so ZeRO runs
+        # restore onto any topology/replica count (re-shard on resume via
+        # set_update_sharding / ShardedTrainer(shard_update=True))
+        opt_state = zero.to_canonical(opt_state, model.params)
     state = {"params": model.params, "states": model.states,
-             "opt_state": model.opt_state}
+             "opt_state": opt_state}
     ckptr.save(os.path.join(path, "state"), state, force=True)
     ckptr.wait_until_finished()
     return path

@@ -502,6 +502,84 @@ def test_gl009_repo_has_no_raw_retry_loops():
     assert new == [] and matched == []
 
 
+def test_gl010_train_step_jits_donate_state():
+    HOT = "deeplearning4j_tpu/nn/multilayer/network.py"
+    # call form without donation over a params/opt_state-taking def fires
+    seeded = ("""\
+import jax
+
+def make_step(tx):
+    def train_step(params, opt_state, x):
+        return params, opt_state
+    return jax.jit(train_step)
+""")
+    assert [(v.rule, v.line) for v in lint(seeded, rel_path=HOT,
+                                           rules=["GL010"])] \
+        == [("GL010", 6)]
+    # donate_argnums present -> quiet
+    donated = seeded.replace("jax.jit(train_step)",
+                             "jax.jit(train_step, donate_argnums=(0, 1))")
+    assert lint(donated, rel_path=HOT, rules=["GL010"]) == []
+    # decorator form fires (can't pass donate_argnums at all)
+    deco = ("""\
+import jax
+
+@jax.jit
+def pstep(params, opt_state, x):
+    return params, opt_state
+""")
+    assert [(v.rule, v.line) for v in lint(deco, rel_path=HOT,
+                                           rules=["GL010"])] \
+        == [("GL010", 4)]
+    # inline lambda with a state arg fires too
+    lam = ("""\
+import jax
+
+def build():
+    return jax.jit(lambda params, x: params)
+""")
+    assert [(v.rule, v.line) for v in lint(lam, rel_path=HOT,
+                                           rules=["GL010"])] \
+        == [("GL010", 4)]
+    # a jit over a state-free function stays quiet (inference helpers that
+    # don't touch params by name are not the rule's business)...
+    quiet = ("""\
+import jax
+
+def make(fn):
+    def fwd(xs, mask):
+        return fn(xs, mask)
+    return jax.jit(fwd)
+""")
+    assert lint(quiet, rel_path=HOT, rules=["GL010"]) == []
+    # ...an opaque callee resolves to nothing and stays quiet...
+    opaque = ("""\
+import jax
+
+def wrap(step_fn):
+    return jax.jit(step_fn)
+""")
+    assert lint(opaque, rel_path=HOT, rules=["GL010"]) == []
+    # ...and outside the nn//parallel/ hot modules the rule is scoped off
+    assert lint(seeded, rel_path="deeplearning4j_tpu/serving/server.py",
+                rules=["GL010"]) == []
+
+
+def test_gl010_repo_hot_modules_donate_or_are_baselined():
+    """Satellite gate: every params/opt_state jit in nn/ and parallel/
+    donates its state args; the only remainders are the two inference
+    executables (output() on both network classes), baselined with notes —
+    nothing may join them silently."""
+    report = Analyzer(rules=[get_rule("GL010")], root=str(REPO)).analyze_paths(
+        ["deeplearning4j_tpu"])
+    assert report.errors == []
+    new, matched = Baseline.load(str(BASELINE_PATH)).split(report.violations)
+    assert new == []
+    assert sorted(v.path for v in matched) == \
+        ["deeplearning4j_tpu/nn/graph/graph.py",
+         "deeplearning4j_tpu/nn/multilayer/network.py"]
+
+
 # ---------------------------------------------------------------- baseline
 
 def test_baseline_round_trip_via_cli(tmp_path):
@@ -632,7 +710,7 @@ def test_cli_rule_subset_and_list_rules():
         assert rule.id in proc.stdout and rule.rationale
     assert [r.id for r in all_rules()] == \
         ["GL001", "GL002", "GL003", "GL004", "GL005", "GL006", "GL007",
-         "GL008", "GL009"]
+         "GL008", "GL009", "GL010"]
 
 
 def test_repo_gate_is_clean_and_fast():

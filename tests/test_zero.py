@@ -1,0 +1,391 @@
+"""ZeRO-1 sharded weight update & optimizer state (parallel/zero.py;
+arXiv 2004.13336, ROADMAP item 4) on the 8-device virtual CPU mesh.
+
+The contract under test: `ShardedTrainer(shard_update=True)` /
+`ParallelWrapper(zero=True)` partition every updater-state tensor and the
+parameter update over the data axis — reduce-scatter grads, per-shard optax
+update, all-gather fresh params — with training math IDENTICAL to the
+replicated update (f32 tolerance), per-device state bytes cut by the axis
+size, donation intact (no "donated buffers were not usable" warnings), and
+checkpoints that restore/reshard across replica-count changes.
+"""
+import os
+import warnings
+
+import numpy as np
+import pytest
+import jax
+import jax.numpy as jnp
+
+from deeplearning4j_tpu import (NeuralNetConfiguration, InputType, DenseLayer,
+                                OutputLayer, MultiLayerNetwork, DataSet, Adam,
+                                Sgd)
+from deeplearning4j_tpu.datasets.iterator.base import ListDataSetIterator
+from deeplearning4j_tpu.parallel.sharding import (make_mesh, ShardedTrainer,
+                                                  ShardingRules)
+from deeplearning4j_tpu.parallel.zero import ZeroUpdater, per_device_bytes
+from jax.sharding import PartitionSpec as P
+
+
+def _toy(n=64, nin=8, nout=3, seed=0):
+    rng = np.random.default_rng(seed)
+    X = rng.normal(size=(n, nin)).astype(np.float32)
+    w = rng.normal(size=(nin, nout))
+    y = np.argmax(X @ w, axis=1)
+    return X, np.eye(nout, dtype=np.float32)[y]
+
+
+def _conf(nin=8, nout=3, updater=None, seed=42, hidden=16):
+    # hidden=16 -> param sizes 128/16/48/3: the [3] output bias does NOT
+    # divide the 8-way data axis, so every run exercises the pad path
+    return (NeuralNetConfiguration.builder()
+            .seed(seed).updater(updater or Adam(1e-2))
+            .list()
+            .layer(DenseLayer(n_out=hidden, activation="relu"))
+            .layer(OutputLayer(n_out=nout, activation="softmax", loss="MCXENT"))
+            .input_type(InputType.feed_forward(nin))
+            .build())
+
+
+def _graph_net(seed=5, updater=None):
+    from deeplearning4j_tpu.nn.conf.configuration import \
+        NeuralNetConfiguration as NNC
+    from deeplearning4j_tpu.nn.graph.graph import ComputationGraph
+    gb = (NNC.builder().seed(seed).updater(updater or Adam(1e-2))
+          .graph_builder().add_inputs("in"))
+    gb.add_layer("d1", DenseLayer(n_out=16, activation="relu"), "in")
+    gb.add_layer("out", OutputLayer(n_out=3, activation="softmax",
+                                    loss="MCXENT"), "d1")
+    gb.set_outputs("out")
+    gb.set_input_types(InputType.feed_forward(8))
+    return ComputationGraph(gb.build()).init()
+
+
+# ------------------------------------------------------------------- parity
+
+def test_zero_bit_parity_multilayer_uneven_params():
+    """Same seed, N steps: replicated vs ZeRO-sharded update produce
+    identical params (f32 tolerance) — including the [3] output bias whose
+    size does not divide the 8-way mesh (padding path)."""
+    X, Y = _toy()
+    ds = DataSet(X, Y)
+    a = MultiLayerNetwork(_conf()).init()
+    for _ in range(5):
+        a.fit_batch(ds)
+    b = MultiLayerNetwork(_conf()).init()
+    tr = ShardedTrainer(b, mesh=make_mesh(n_data=8), shard_update=True)
+    for _ in range(5):
+        tr.fit_batch(ds)
+    np.testing.assert_allclose(a.get_flat_params(), b.get_flat_params(),
+                               rtol=1e-5, atol=1e-6)
+    # the moments really live sharded over the data axis between steps
+    flat_specs = [l.sharding.spec for l in
+                  jax.tree_util.tree_leaves(b.opt_state)
+                  if getattr(l, "ndim", 0) >= 1]
+    assert flat_specs and all(s == P("data") for s in flat_specs)
+
+
+def test_zero_bit_parity_computation_graph():
+    X, Y = _toy()
+    ds = DataSet(X, Y)
+    a = _graph_net()
+    for _ in range(5):
+        a.fit_batch(ds)
+    b = _graph_net()
+    tr = ShardedTrainer(b, mesh=make_mesh(n_data=8), shard_update=True)
+    for _ in range(5):
+        tr.fit_batch(ds)
+    np.testing.assert_allclose(a.get_flat_params(), b.get_flat_params(),
+                               rtol=1e-5, atol=1e-6)
+
+
+def test_zero_scanned_multistep_parity():
+    """fit(steps_per_execution=K) compiles K ZeRO-sharded steps into ONE
+    scanned executable — params must still match the single-device run."""
+    sets = [DataSet(*_toy(n=32, seed=s)) for s in range(8)]
+    a = MultiLayerNetwork(_conf()).init()
+    for ds in sets:
+        a.fit_batch(ds)
+    b = MultiLayerNetwork(_conf()).init()
+    tr = ShardedTrainer(b, mesh=make_mesh(n_data=8), shard_update=True)
+    tr.fit(ListDataSetIterator(sets), steps_per_execution=4)
+    np.testing.assert_allclose(a.get_flat_params(), b.get_flat_params(),
+                               rtol=1e-5, atol=1e-6)
+    assert b.iteration_count == 8
+
+
+def test_zero_tbptt_parity_and_donation_clean():
+    """Both TBPTT paths (per-window fit_batch and the scanned multi_tbptt
+    executable) run with the ZeRO update — identical params to the
+    replicated run, zero donation warnings (the sharded state leaves keep
+    identical shapes across the step, so aliasing still sticks)."""
+    from deeplearning4j_tpu.zoo.models import char_rnn_lstm
+
+    def mk():
+        return char_rnn_lstm(vocab_size=12, hidden=16, layers=2,
+                             tbptt=5).init()
+
+    rng = np.random.default_rng(0)
+    ids = rng.integers(0, 12, size=(8, 21))
+    x = np.eye(12, dtype=np.float32)[ids[:, :-1]]
+    y = np.eye(12, dtype=np.float32)[ids[:, 1:]]
+    ds = DataSet(jnp.asarray(x), jnp.asarray(y))
+
+    a = mk()
+    a.fit_batch(ds)
+    plan_a = a.prepare_steps([ds] * 2)
+    assert plan_a is not None and plan_a[0] == "tbptt"
+    a.fit_prepared(plan_a)
+
+    b = mk()
+    b.set_update_sharding(ZeroUpdater(make_mesh(n_data=8)))
+    with warnings.catch_warnings(record=True) as caught:
+        warnings.simplefilter("always")
+        b.fit_batch(ds)                       # per-window tbptt path
+        plan_b = b.prepare_steps([ds] * 2)
+        assert plan_b is not None and plan_b[0] == "tbptt"
+        b.fit_prepared(plan_b)                # scanned multi_tbptt path
+    donation = [str(w.message) for w in caught
+                if "donated buffers were not usable" in str(w.message)]
+    assert donation == [], donation
+    for pa, pb in zip(jax.tree_util.tree_leaves(a.params),
+                      jax.tree_util.tree_leaves(b.params)):
+        np.testing.assert_allclose(np.asarray(pa), np.asarray(pb),
+                                   rtol=1e-5, atol=1e-6)
+
+
+def test_zero_std_paths_no_donation_warnings():
+    """ISSUE acceptance: the ZeRO std step and the scanned multistep must
+    not trip "Some donated buffers were not usable" — HBM bytes are the
+    whole point of the transform."""
+    sets = [DataSet(*_toy(n=32, seed=s)) for s in range(4)]
+    net = MultiLayerNetwork(_conf()).init()
+    tr = ShardedTrainer(net, mesh=make_mesh(n_data=8), shard_update=True)
+    with warnings.catch_warnings(record=True) as caught:
+        warnings.simplefilter("always")
+        tr.fit_batch(sets[0])                              # std jit step
+        tr.fit(ListDataSetIterator(sets), steps_per_execution=4)  # scanned
+    donation = [str(w.message) for w in caught
+                if "donated buffers were not usable" in str(w.message)]
+    assert donation == [], donation
+
+
+def test_zero_tensor_parallel_layer_excluded_first_match():
+    """A layer carrying a tensor-parallel spec under the first-match
+    ShardingRules keeps its ordinary per-layer update (moments mirror the
+    TP param shardings); the remaining layers zero-shard — and the math
+    still matches the single-device run."""
+    X, Y = _toy(n=32)
+    ds = DataSet(X, Y)
+    a = MultiLayerNetwork(_conf(seed=7)).init()
+    a.fit_batch(ds)
+
+    b = MultiLayerNetwork(_conf(seed=7)).init()
+    mesh = make_mesh(n_data=2, n_model=4)
+    rules = ShardingRules()
+    rules.add(r"^0/W$", P(None, "model"))
+    rules.add(r"^0/b$", P("model"))
+    tr = ShardedTrainer(b, mesh=mesh, rules=rules, shard_update=True)
+    assert not tr.zero.included("0", b.params["0"])
+    assert tr.zero.included("1", b.params["1"])
+    tr.fit_batch(ds)
+    np.testing.assert_allclose(a.get_flat_params(), b.get_flat_params(),
+                               rtol=1e-5, atol=1e-6)
+    # excluded layer's W-moment mirrors the TP sharding; included layer's
+    # moments are flat data-axis shards
+    leaves = jax.tree_util.tree_flatten_with_path(b.opt_state)[0]
+    specs = {}
+    for path, leaf in leaves:
+        if hasattr(leaf, "sharding"):
+            specs[jax.tree_util.keystr(path)] = leaf.sharding.spec
+    tp = [s for k, s in specs.items() if k.startswith("['0'") and "'W'" in k
+          and s == P(None, "model")]
+    flat = [s for k, s in specs.items() if k.startswith("['1'")
+            and s == P("data")]
+    assert tp and flat, specs
+
+
+def test_parallel_wrapper_zero_facade_trains():
+    X, Y = _toy(n=256)
+    from deeplearning4j_tpu import INDArrayDataSetIterator
+    from deeplearning4j_tpu.parallel.parallel_wrapper import ParallelWrapper
+    net = MultiLayerNetwork(_conf()).init()
+    pw = (ParallelWrapper.builder(net).workers(8).zero(True).build())
+    s0 = net.score(DataSet(X, Y))
+    pw.fit(INDArrayDataSetIterator(X, Y, 64), epochs=5)
+    assert net.score(DataSet(X, Y)) < s0
+
+
+# ------------------------------------------------------- bytes & telemetry
+
+def test_zero_state_bytes_at_least_4x_smaller_and_gauges_report():
+    """ISSUE acceptance: with 8 devices on the data axis, per-device
+    optimizer-state bytes drop >= 4x vs replicated (Adam: ~8x minus
+    padding), and the telemetry gauges carry the attribution."""
+    net_r = MultiLayerNetwork(_conf(hidden=128)).init()
+    ShardedTrainer(net_r, mesh=make_mesh(n_data=8))
+    repl = per_device_bytes(net_r.opt_state)
+
+    net_z = MultiLayerNetwork(_conf(hidden=128)).init()
+    ShardedTrainer(net_z, mesh=make_mesh(n_data=8), shard_update=True)
+    sharded = per_device_bytes(net_z.opt_state)
+    assert sharded * 4 <= repl, (sharded, repl)
+    # params stay replicated (the forward consumes them everywhere)
+    assert per_device_bytes(net_z.params) == per_device_bytes(net_r.params)
+
+    from deeplearning4j_tpu.telemetry.registry import get_registry
+    series = {}
+    for labels, value in get_registry().gauge(
+            "opt_state_bytes_per_device").series():
+        series[labels.get("mode")] = value
+    assert series["zero"] == sharded
+    assert series["replicated"] == repl
+    assert get_registry().gauge("param_bytes_per_device").series()
+
+
+# ---------------------------------------------------------- checkpointing
+
+def test_zero_zip_checkpoint_reshards_replica_count_change(tmp_path):
+    """ModelSerializer zips store CANONICAL (per-param, unpadded) updater
+    state: a run checkpointed at 8 shards restores into a plain model and
+    resumes at 4 shards with momentum intact — params match an
+    uninterrupted single-device run."""
+    from deeplearning4j_tpu.util.model_serializer import ModelSerializer
+    X, Y = _toy()
+    ds = DataSet(X, Y)
+    oracle = MultiLayerNetwork(_conf()).init()
+    for _ in range(6):
+        oracle.fit_batch(ds)
+
+    b = MultiLayerNetwork(_conf()).init()
+    ShardedTrainer(b, mesh=make_mesh(n_data=8), shard_update=True) \
+        .fit(ListDataSetIterator([ds] * 3))
+    path = str(tmp_path / "zero.zip")
+    ModelSerializer.write_model(b, path)
+
+    restored = ModelSerializer.restore(path)
+    # canonical layout: every >=1-D opt leaf has a param's exact shape
+    pshapes = {tuple(l.shape) for l in
+               jax.tree_util.tree_leaves(restored.params)}
+    for leaf in jax.tree_util.tree_leaves(restored.opt_state):
+        if getattr(leaf, "ndim", 0) >= 1:
+            assert tuple(leaf.shape) in pshapes
+    tr4 = ShardedTrainer(restored,
+                         mesh=make_mesh(n_data=4, devices=jax.devices()[:4]),
+                         shard_update=True)
+    for _ in range(3):
+        tr4.fit_batch(ds)
+    np.testing.assert_allclose(oracle.get_flat_params(),
+                               restored.get_flat_params(),
+                               rtol=1e-5, atol=1e-6)
+
+
+def test_zero_orbax_sharded_checkpoint_roundtrip(tmp_path):
+    """The orbax tensor-store format stores canonical updater state too, so
+    save_sharded/restore_sharded round-trips a ZeRO run and re-shards on
+    resume."""
+    from deeplearning4j_tpu.util.sharded_checkpoint import (save_sharded,
+                                                            restore_sharded)
+    X, Y = _toy()
+    ds = DataSet(X, Y)
+    oracle = MultiLayerNetwork(_conf()).init()
+    for _ in range(5):
+        oracle.fit_batch(ds)
+
+    b = MultiLayerNetwork(_conf()).init()
+    tr = ShardedTrainer(b, mesh=make_mesh(n_data=8), shard_update=True)
+    for _ in range(3):
+        tr.fit_batch(ds)
+    save_sharded(b, tmp_path / "ck")
+    restored = restore_sharded(tmp_path / "ck")
+    tr2 = ShardedTrainer(restored, mesh=make_mesh(n_data=8),
+                         shard_update=True)
+    for _ in range(2):
+        tr2.fit_batch(ds)
+    np.testing.assert_allclose(oracle.get_flat_params(),
+                               restored.get_flat_params(),
+                               rtol=1e-5, atol=1e-6)
+
+
+def test_fault_tolerant_trainer_resumes_zero_run_on_fewer_replicas(tmp_path):
+    """FaultTolerantTrainer drives a ShardedTrainer(zero) unchanged: the
+    checkpoint zips hold the INNER network with canonical state; a restart
+    whose factory builds a 4-replica trainer adopts the 8-replica
+    checkpoint, re-shards, fast-forwards, and lands on the uninterrupted
+    run's params."""
+    from deeplearning4j_tpu.train.fault_tolerance import (CheckpointConfig,
+                                                          FaultTolerantTrainer)
+    X, Y = _toy()
+    ds = DataSet(X, Y)
+    ckdir = str(tmp_path / "ck")
+
+    t1 = FaultTolerantTrainer(
+        lambda: ShardedTrainer(MultiLayerNetwork(_conf()).init(),
+                               mesh=make_mesh(n_data=8), shard_update=True),
+        CheckpointConfig(ckdir, frequency=2))
+    assert not t1.resumed
+    t1.fit(ListDataSetIterator([ds] * 4), epochs=1)        # iterations 1..4
+
+    t2 = FaultTolerantTrainer(
+        lambda: ShardedTrainer(MultiLayerNetwork(_conf()).init(),
+                               mesh=make_mesh(n_data=4,
+                                              devices=jax.devices()[:4]),
+                               shard_update=True),
+        CheckpointConfig(ckdir, frequency=2))
+    assert t2.resumed
+    t2.fit(ListDataSetIterator([ds] * 4), epochs=2)        # iterations 5..8
+
+    oracle = MultiLayerNetwork(_conf()).init()
+    for _ in range(8):
+        oracle.fit_batch(ds)
+    np.testing.assert_allclose(oracle.get_flat_params(),
+                               t2._net().get_flat_params(),
+                               rtol=1e-5, atol=1e-6)
+    assert t2._net().iteration_count == 8
+
+
+def test_plain_trainer_after_zero_trainer_reverts_to_replicated():
+    """shard_update=False means REPLICATED: wrapping a previously
+    ZeRO-trained model in a plain ShardedTrainer (even on a DIFFERENT mesh
+    size) must convert the updater state back to canonical instead of
+    crashing on the stale mesh placement — and keep training to parity."""
+    X, Y = _toy()
+    ds = DataSet(X, Y)
+    oracle = MultiLayerNetwork(_conf()).init()
+    for _ in range(4):
+        oracle.fit_batch(ds)
+
+    net = MultiLayerNetwork(_conf()).init()
+    tr8 = ShardedTrainer(net, mesh=make_mesh(n_data=8), shard_update=True)
+    for _ in range(2):
+        tr8.fit_batch(ds)
+    assert net._zero is not None
+    tr4 = ShardedTrainer(net, mesh=make_mesh(n_data=4,
+                                             devices=jax.devices()[:4]))
+    assert net._zero is None
+    # canonical again: every >=1-D opt leaf has a param's exact shape
+    pshapes = {tuple(l.shape) for l in jax.tree_util.tree_leaves(net.params)}
+    for leaf in jax.tree_util.tree_leaves(net.opt_state):
+        if getattr(leaf, "ndim", 0) >= 1:
+            assert tuple(leaf.shape) in pshapes
+    for _ in range(2):
+        tr4.fit_batch(ds)
+    np.testing.assert_allclose(oracle.get_flat_params(),
+                               net.get_flat_params(),
+                               rtol=1e-5, atol=1e-6)
+
+
+def test_zero_tx_honors_partial_update_contract():
+    """per_layer_transform.update accepts a SUBSET of layers (PipelineTrainer
+    updates one stage's layers at a time with single-layer dicts); the ZeRO
+    wrap must preserve that contract instead of KeyError-ing on absent
+    layers."""
+    net = MultiLayerNetwork(_conf()).init()
+    net.set_update_sharding(ZeroUpdater(make_mesh(n_data=8)))
+    grads = jax.tree_util.tree_map(jnp.ones_like, net.params)
+    ups, new_state = net._tx.update({"1": grads["1"]},
+                                    {"1": net.opt_state["1"]},
+                                    {"1": net.params["1"]})
+    assert set(ups) == {"1"} and set(new_state) == {"1"}
+    for k, u in ups["1"].items():
+        assert u.shape == net.params["1"][k].shape
