@@ -638,13 +638,77 @@ def _session_probe(steps=320, trials=5):
                                trials=trials) * 1e3
 
 
+def bench_decode(slots=8, max_len=256, prompt_len=64, steps=48, vocab=256,
+                 trials=3):
+    """Autoregressive decode serving (the /generate plane, ROADMAP item 2):
+    transformer_lm through the KV-cache decode engine at FULL slot
+    occupancy — `slots` co-batched requests advanced one token per
+    fixed-shape step executable (decode/engine.py), exactly what the
+    DecodeScheduler dispatches in steady state. Reports:
+      - decode_tokens_per_sec: slots*steps / best trial wall (per chip),
+        the release-over-release throughput guard;
+      - ttft_ms_p50: median WARM prefill wall (prompt_len tokens through
+        the masked flash prefill leg — the compile-paying first prefill is
+        excluded, same convention as every steady-state number here);
+      - decode_itl_ms: per-token inter-token latency at full occupancy.
+    The engine's step donates the multi-MB cache, so the run rides inside
+    main()'s donation-warning net like every other workload."""
+    from deeplearning4j_tpu.decode.engine import DecodeEngine
+    from deeplearning4j_tpu.zoo.models import transformer_lm
+    import jax
+
+    net = transformer_lm(vocab_size=vocab, d_model=256, n_layers=4,
+                         n_heads=4)
+    net.init()
+    eng = DecodeEngine(net, slots=slots, max_len=max_len)
+    rng = np.random.default_rng(0)
+    prompts = rng.integers(0, vocab, size=(slots, prompt_len))
+
+    def fill():
+        cache = eng.init_cache()
+        walls = []
+        for s in range(slots):
+            t0 = time.perf_counter()
+            cache, nid, _ = eng.prefill(cache, s, prompts[s])
+            jax.block_until_ready(cache["lengths"])
+            walls.append((time.perf_counter() - t0) * 1e3)
+        return cache, walls
+
+    cache, first_walls = fill()                 # first prefill = compile
+    ttfts = first_walls[1:]
+    ids = np.zeros((slots,), np.int32)
+    cache, nxt, _ = eng.step(cache, ids)        # compile the step
+    best_s = None
+    for _ in range(trials):
+        cache, walls = fill()
+        ttfts.extend(walls)
+        nxt = np.zeros((slots,), np.int32)
+        t0 = time.perf_counter()
+        for _ in range(steps):
+            cache, nxt, _ = eng.step(cache, nxt)
+        jax.block_until_ready(cache["lengths"])
+        wall = time.perf_counter() - t0
+        best_s = wall if best_s is None else min(best_s, wall)
+    tokens_per_sec = slots * steps / best_s
+    return {"tokens_per_sec": tokens_per_sec,
+            "itl_ms": best_s / steps * 1e3,
+            "ttft_ms_p50": float(np.median(ttfts)),
+            "slots": slots, "prompt_len": prompt_len, "max_len": max_len,
+            "cache_mb": eng.cache_bytes() / 1e6}
+
+
 # metrics compared against the best prior BENCH_r*.json (higher is better);
 # >30% drops surface in the "regressions" list so relay weather and real
 # regressions are distinguishable at a glance (VERDICT r4 next #5)
 WATCHED_METRICS = ("value", "lenet_samples_per_sec", "char_rnn_chars_per_sec",
                    "transformer_lm_tokens_per_sec", "word2vec_pairs_per_sec",
                    "flash_speedup", "e2e_samples_per_sec", "e2e_vs_compute",
-                   "ucidigits_test_acc", "real32_test_acc")
+                   "ucidigits_test_acc", "real32_test_acc",
+                   "decode_tokens_per_sec")
+# lower-is-better latency metrics: best prior = the MINIMUM, and a >50%
+# degradation (1.5x the best) lands in "regressions" (wider margin than the
+# throughput 30%: single-request latency is noisier on the shared relay)
+WATCHED_LOWER_METRICS = ("ttft_ms_p50", "decode_itl_ms")
 _RENAMED = {"mnist_real_test_acc": "ucidigits_test_acc"}
 
 
@@ -668,11 +732,22 @@ def _regressions_vs_prior(current):
             v = prior.get(k)
             if isinstance(v, (int, float)) and (k not in best or v > best[k]):
                 best[k] = float(v)
+        for k in WATCHED_LOWER_METRICS:
+            v = prior.get(k)
+            if isinstance(v, (int, float)) and (k not in best or v < best[k]):
+                best[k] = float(v)
     out = []
     for k in WATCHED_METRICS:
         now = current.get(k)
         if k in best and isinstance(now, (int, float)) and best[k] > 0 \
                 and now < 0.7 * best[k]:
+            out.append({"metric": k, "best_prior": round(best[k], 2),
+                        "now": round(float(now), 2),
+                        "ratio": round(float(now) / best[k], 3)})
+    for k in WATCHED_LOWER_METRICS:
+        now = current.get(k)
+        if k in best and isinstance(now, (int, float)) and best[k] > 0 \
+                and now > 1.5 * best[k]:
             out.append({"metric": k, "best_prior": round(best[k], 2),
                         "now": round(float(now), 2),
                         "ratio": round(float(now) / best[k], 3)})
@@ -907,6 +982,7 @@ def main():
                ("char_rnn", lambda: bench_char_rnn()),
                ("transformer", lambda: bench_transformer_lm()),
                ("flash", lambda: bench_flash_attention()),
+               ("decode", lambda: bench_decode()),
                ("word2vec", lambda: bench_word2vec()),
                ("scaling", lambda: bench_scaling_subprocess())]
     if headline_is_resnet:
@@ -966,6 +1042,14 @@ def main():
                 extras["ring_1dev_fwdbwd_ms"] = round(r["ring_1dev_ms"], 2)
                 extras["ring_vs_flash"] = round(
                     r["ring_1dev_ms"] / r["flash_ms"], 2)
+            elif name == "decode":
+                extras["decode_tokens_per_sec"] = round(r["tokens_per_sec"],
+                                                        1)
+                extras["ttft_ms_p50"] = round(r["ttft_ms_p50"], 2)
+                extras["decode_itl_ms"] = round(r["itl_ms"], 3)
+                extras["decode_slots"] = r["slots"]
+                extras["decode_prompt_len"] = r["prompt_len"]
+                extras["decode_cache_mb"] = round(r["cache_mb"], 1)
             elif name == "word2vec":
                 extras["word2vec_pairs_per_sec"] = round(r, 1)
             else:

@@ -782,3 +782,90 @@ class JitMissingDonationRule(Rule):
     def _takes_state(cls, fn):
         names = {a.arg for a in fn.args.args + fn.args.kwonlyargs}
         return bool(names & cls.STATE_ARGS)
+
+
+# ---------------------------------------------------------------------------
+# GL011 — decode-dynamic-shape
+# ---------------------------------------------------------------------------
+
+@register
+class DecodeDynamicShapeRule(Rule):
+    """Token-count-dependent shapes in decode/generate loops."""
+
+    id = "GL011"
+    name = "decode-dynamic-shape"
+    rationale = (
+        "An autoregressive decode loop that grows a tensor per token "
+        "(jnp.concatenate/append of the sequence-so-far) or derives a "
+        "shape from a python-int len() of the tokens-so-far presents XLA "
+        "with a NEW shape every token — one full executable compile per "
+        "generated token, orders of magnitude over the dispatch cost (the "
+        "Julia-TPU paper's central observation, and the exact failure mode "
+        "the decode engine's fixed-shape KV cache + dynamic_update_slice "
+        "exists to prevent). In a decode-loop-named function, grow a "
+        "FIXED-capacity buffer with lax.dynamic_update_slice and mask by a "
+        "length vector instead.")
+
+    # functions (any enclosing def) whose name marks a decode/token loop
+    NAME_RE = re.compile(r"decode|generate|autoregress|token_loop",
+                         re.IGNORECASE)
+    GROW_CALLS = frozenset({
+        "numpy.concatenate", "numpy.append", "numpy.hstack", "numpy.vstack",
+        "jax.numpy.concatenate", "jax.numpy.append", "jax.numpy.hstack",
+        "jax.numpy.vstack"})
+    SHAPE_CTORS = frozenset({
+        "numpy.zeros", "numpy.ones", "numpy.full", "numpy.empty",
+        "numpy.arange", "jax.numpy.zeros", "jax.numpy.ones",
+        "jax.numpy.full", "jax.numpy.empty", "jax.numpy.arange",
+        "jax.nn.one_hot"})
+
+    def check(self, ctx):
+        aliases = ctx.aliases
+        for node in ctx.nodes:
+            if not isinstance(node, ast.Call):
+                continue
+            if not self._in_decode_loop(ctx, node):
+                continue
+            qual = qualname(node.func, aliases)
+            if qual in self.GROW_CALLS:
+                yield self.violation(
+                    ctx, node,
+                    f"{qual.split('.')[-1]} inside a decode loop grows the "
+                    "sequence tensor per token — a fresh shape (and XLA "
+                    "compile) every step; append into a fixed-capacity "
+                    "cache with lax.dynamic_update_slice + a length mask")
+            elif qual in self.SHAPE_CTORS and self._len_arg(node):
+                yield self.violation(
+                    ctx, node,
+                    f"{qual.split('.')[-1]} sized by len(...) inside a "
+                    "decode loop — a python-int shape that tracks the "
+                    "token count recompiles every step; size by the fixed "
+                    "cache capacity and mask the tail")
+
+    @classmethod
+    def _in_decode_loop(cls, ctx, node):
+        """Inside a for/while that is itself inside (or equal to the body
+        of) a def whose name matches NAME_RE. The loop requirement keeps
+        one-shot setup concat (building the prompt) quiet; the name
+        requirement keeps ordinary data plumbing quiet."""
+        in_loop = False
+        for anc in ctx.ancestors(node):
+            if isinstance(anc, (ast.For, ast.While)):
+                in_loop = True
+            elif isinstance(anc, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                if in_loop and cls.NAME_RE.search(anc.name):
+                    return True
+                # keep walking: a helper defined inside a decode fn whose
+                # OWN name doesn't match is still that decode loop's body
+        return False
+
+    @staticmethod
+    def _len_arg(call):
+        """Any argument expression containing a len(...) call."""
+        for arg in list(call.args) + [kw.value for kw in call.keywords]:
+            for sub in ast.walk(arg):
+                if isinstance(sub, ast.Call) \
+                        and isinstance(sub.func, ast.Name) \
+                        and sub.func.id == "len":
+                    return True
+        return False

@@ -1,0 +1,29 @@
+"""Autoregressive decode subsystem: KV-cache continuous batching.
+
+The LLM-style workloads this repo trains (`zoo.transformer_lm`,
+`zoo.char_rnn_lstm`) are served token-by-token here, with the same
+zero-steady-state-recompile discipline the serving batcher and device-side
+ingest established:
+
+- `DecodeEngine` compiles exactly TWO kinds of executables per model: one
+  fixed-shape decode step (every token, every mix of co-batched requests)
+  and one prefill per power-of-two prompt-length bucket. The KV cache is a
+  fixed [slots, capacity, heads, head_dim] tensor per attention layer
+  (plus a [slots, n_out] carry pair per recurrent layer) with a per-slot
+  length vector; appends are `lax.dynamic_update_slice` writes, and the
+  attention step masks against the length vector inside the flash kernel
+  (`kernels.flash_attention.flash_decode`).
+- `DecodeScheduler` owns slot lifecycle: requests join free slots and
+  retire PER TOKEN (continuous batching), with admission shedding,
+  per-token deadline budgets, TTFT/ITL histograms with trace exemplars,
+  and ModelRegistry hot-swap (drain-then-swap, engines cached per model so
+  a rollback never recompiles).
+
+`ServingServer(decode=True)` exposes this as POST /generate, routed through
+the same FleetFrontend failover/canary layer as /predict.
+"""
+from .engine import DecodeEngine, DecodeUnsupported
+from .scheduler import DecodeScheduler, GenerateRequest
+
+__all__ = ["DecodeEngine", "DecodeScheduler", "DecodeUnsupported",
+           "GenerateRequest"]

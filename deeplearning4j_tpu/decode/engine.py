@@ -1,0 +1,453 @@
+"""DecodeEngine: fixed-shape KV-cache decode executables for the nn types.
+
+One engine serves one model with exactly two executable families:
+
+- ``step``: ONE compiled function of fixed shape — [slots] token ids in,
+  [slots] next ids out — that advances EVERY in-flight request by one token.
+  Attention layers append the token's k/v into their [slots, capacity, H,
+  Dh] cache rows with a per-slot `lax.dynamic_update_slice` (vmapped over
+  the slot axis) and attend against the cache masked by the per-slot length
+  vector (kernels.flash_attention.flash_decode); recurrent layers carry
+  their (h, c) state in [slots, n_out] cache rows. Because every shape is a
+  function of (slots, capacity) only — never of how many tokens any request
+  has generated — steady-state decoding NEVER recompiles, no matter how
+  requests join and leave the batch.
+- ``prefill``: one compiled function per power-of-two prompt-length bucket.
+  The prompt runs as a normal full-sequence forward (causal attention via
+  the masked flash kernel — the same padded+masked length-bucket discipline
+  the serving batcher applies to /predict), each attention layer's K/V
+  projections land in the slot's cache rows in one dynamic_update_slice,
+  and the recurrent final carries land in the slot's carry rows. Pad
+  positions write garbage K/V beyond `length`; the length mask keeps every
+  later step from ever attending to them.
+
+The cache is a plain pytree ``{"lengths": int32[slots], "layers": {name:
+entry}}`` threaded functionally through both executables and DONATED, so
+steady state re-uses the cache buffers in place instead of allocating a
+fresh multi-MB cache per token.
+
+Decode runs in the model's param dtype (no mixed-precision cast): decode is
+bound by streaming cache bytes, not MXU throughput, and greedy parity with
+``model.output`` is the contract the tests pin.
+"""
+from __future__ import annotations
+
+import threading
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from ..nn.layers.convolution import LayerNormalizationModule
+from ..nn.layers.feedforward import (DenseLayerModule, EmbeddingLayerModule,
+                                     LossLayerModule, OutputLayerModule,
+                                     RnnOutputLayerModule)
+from ..nn.layers.misc import ActivationLayerModule, DropoutLayerModule
+from ..nn.layers.recurrent import (GravesBidirectionalLSTMModule,
+                                   SelfAttentionLayerModule, _BaseLSTMModule)
+from ..telemetry.xla import record_jit_compile
+from ..util.time_source import monotonic_s
+
+
+class DecodeUnsupported(TypeError):
+    """The model contains a construct with no token-streaming semantics
+    (bidirectional recurrence, non-causal attention, temporal pooling...)."""
+
+
+# layers whose forward is a pure per-position map ([b,t,f] -> [b,t,g] with
+# position i depending only on position i): safe in both decode legs
+_POSITIONWISE = (DenseLayerModule, EmbeddingLayerModule, RnnOutputLayerModule,
+                 OutputLayerModule, LossLayerModule, ActivationLayerModule,
+                 DropoutLayerModule, LayerNormalizationModule)
+
+# graph vertices that are per-position maps over their inputs
+_POSITIONWISE_VERTICES = ("ElementWiseVertex", "MergeVertex")
+
+MIN_PREFILL_BUCKET = 16   # floor the prompt buckets: bounds the executable
+                          # set at log2(capacity/16)+1 without measurable
+                          # padding waste at serving prompt sizes
+
+
+def bucket_for_len(n, capacity):
+    """Smallest power-of-two >= n (floored at MIN_PREFILL_BUCKET, capped at
+    the cache capacity) — the prefill executable key."""
+    b = MIN_PREFILL_BUCKET
+    while b < n:
+        b <<= 1
+    return min(b, capacity)
+
+
+class _Node:
+    __slots__ = ("name", "kind", "inputs", "module", "vertex")
+
+    def __init__(self, name, kind, inputs=(), module=None, vertex=None):
+        self.name = name
+        self.kind = kind            # "input" | "layer" | "vertex"
+        self.inputs = tuple(inputs)
+        self.module = module
+        self.vertex = vertex
+
+
+def _check_layer(name, module):
+    if isinstance(module, GravesBidirectionalLSTMModule):
+        raise DecodeUnsupported(
+            f"layer {name!r}: bidirectional recurrence needs future tokens "
+            "and cannot stream")
+    if isinstance(module, SelfAttentionLayerModule):
+        if not getattr(module.conf, "causal", False):
+            raise DecodeUnsupported(
+                f"layer {name!r}: non-causal attention attends to future "
+                "positions and cannot decode incrementally")
+        return
+    if isinstance(module, (_BaseLSTMModule,) + _POSITIONWISE):
+        return
+    raise DecodeUnsupported(
+        f"layer {name!r} ({type(module).__name__}) has no per-token decode "
+        "semantics")
+
+
+def build_plan(model):
+    """(nodes, input_name, output_name, vocab) for a MultiLayerNetwork or a
+    single-input/single-output ComputationGraph."""
+    from ..nn.graph.graph import ComputationGraph
+    from ..nn.multilayer.network import MultiLayerNetwork
+    if isinstance(model, MultiLayerNetwork):
+        it = getattr(model.conf, "input_type", None)
+        vocab = int(it.size) if it is not None and hasattr(it, "size") \
+            else int(model.conf.layers[0].n_in)
+        if getattr(model.conf, "input_preprocessors", None):
+            if any(model.conf.input_preprocessors.get(i) is not None
+                   for i in range(len(model.layers))):
+                raise DecodeUnsupported(
+                    "input preprocessors have no per-token semantics")
+        nodes = [_Node("__in__", "input")]
+        prev = "__in__"
+        for i, module in enumerate(model.layers):
+            _check_layer(str(i), module)
+            nodes.append(_Node(str(i), "layer", (prev,), module=module))
+            prev = str(i)
+        return nodes, "__in__", prev, vocab
+    if isinstance(model, ComputationGraph):
+        conf = model.conf
+        if len(conf.network_inputs) != 1 or len(conf.network_outputs) != 1:
+            raise DecodeUnsupported(
+                "decode requires a single-input/single-output graph")
+        vocab = int(conf.input_types[0].size) if conf.input_types \
+            else int(conf.vertices[model.order[1]].layer_conf.n_in)
+        nodes = []
+        for name in model.order:
+            spec = conf.vertices[name]
+            if spec.kind == "input":
+                nodes.append(_Node(name, "input"))
+            elif spec.kind == "layer":
+                if spec.preprocessor is not None:
+                    raise DecodeUnsupported(
+                        f"vertex {name!r}: preprocessors have no per-token "
+                        "semantics")
+                module = model.layers[name]
+                _check_layer(name, module)
+                nodes.append(_Node(name, "layer", spec.inputs, module=module))
+            else:
+                vc = spec.vertex_conf
+                if type(vc).__name__ not in _POSITIONWISE_VERTICES:
+                    raise DecodeUnsupported(
+                        f"vertex {name!r} ({type(vc).__name__}) is not a "
+                        "per-position map")
+                nodes.append(_Node(name, "vertex", spec.inputs, vertex=vc))
+        return nodes, conf.network_inputs[0], conf.network_outputs[0], vocab
+    raise DecodeUnsupported(f"cannot decode a {type(model).__name__}")
+
+
+class DecodeEngine:
+    def __init__(self, model, *, slots=4, max_len=128, compile_tracker=None,
+                 registry=None):
+        self.model = model
+        self.slots = int(slots)
+        self.capacity = int(max_len)
+        self.nodes, self.input_name, self.output_name, self.vocab = \
+            build_plan(model)
+        if model.params is None:
+            model.init()
+        self._dtype = model._dtype
+        # recurrent carries accumulate in f32 for sub-32-bit param dtypes
+        # (mirrors nn/layers/recurrent._lstm_scan's acc_dt choice)
+        self._acc_dtype = (jnp.float32
+                           if jnp.issubdtype(self._dtype, jnp.floating)
+                           and jnp.finfo(self._dtype).bits < 32
+                           else self._dtype)
+        self.compile_tracker = compile_tracker
+        self.registry = registry            # MetricsRegistry for jit counters
+        self._step_fn = None
+        self._prefill_fns = {}              # length bucket -> jitted fn
+        self._compiled = set()              # labels whose first call was timed
+        self._jit_lock = threading.Lock()
+
+    # ------------------------------------------------------------ cache
+    def init_cache(self):
+        """Fresh all-zero cache pytree (slot lengths all 0)."""
+        layers = {}
+        for node in self.nodes:
+            if node.kind != "layer":
+                continue
+            m = node.module
+            if isinstance(m, SelfAttentionLayerModule):
+                H = int(m.conf.n_heads)
+                Dh = int(m.conf.n_out) // H
+                shape = (self.slots, self.capacity, H, Dh)
+                layers[node.name] = {"k": jnp.zeros(shape, self._dtype),
+                                     "v": jnp.zeros(shape, self._dtype)}
+            elif isinstance(m, _BaseLSTMModule):
+                n_out = int(m.conf.n_out)
+                layers[node.name] = {
+                    "h": jnp.zeros((self.slots, n_out), self._acc_dtype),
+                    "c": jnp.zeros((self.slots, n_out), self._acc_dtype)}
+        return {"lengths": jnp.zeros((self.slots,), jnp.int32),
+                "layers": layers}
+
+    def cache_bytes(self):
+        # eval_shape: sizes from the abstract pytree, no device allocation
+        shapes = jax.eval_shape(self.init_cache)
+        return sum(int(x.size * x.dtype.itemsize)
+                   for x in jax.tree_util.tree_leaves(shapes))
+
+    # ------------------------------------------------------------ walks
+    def _walk_prefill(self, params, states, x0, mask, cache, slot, length):
+        """Full-sequence forward over the plan, capturing each stateful
+        layer's K/V (resp. final carry) into `slot`'s cache rows."""
+        acts = {self.input_name: x0}
+        layers = dict(cache["layers"])
+        for node in self.nodes:
+            if node.kind == "input":
+                continue
+            if node.kind == "vertex":
+                acts[node.name] = node.vertex.apply(
+                    [acts[i] for i in node.inputs])
+                continue
+            m = node.module
+            p, s = params[node.name], states[node.name]
+            x = acts[node.inputs[0]]
+            if isinstance(m, SelfAttentionLayerModule):
+                q, k, v = m.project_qkv(p, x)             # [1, L, H, Dh]
+                out = m.attend(q, k, v, mask)
+                y = m.finish(p, out, mask)
+                entry = layers[node.name]
+                z = jnp.zeros((), slot.dtype)   # match the traced slot's
+                layers[node.name] = {           # index dtype under x64
+                    "k": lax.dynamic_update_slice(
+                        entry["k"], k.astype(entry["k"].dtype),
+                        (slot, z, z, z)),
+                    "v": lax.dynamic_update_slice(
+                        entry["v"], v.astype(entry["v"].dtype),
+                        (slot, z, z, z))}
+            elif isinstance(m, _BaseLSTMModule):
+                n_out = int(m.conf.n_out)
+                zeros = (jnp.zeros((1, n_out), self._dtype),
+                         jnp.zeros((1, n_out), self._dtype))
+                # masked steps carry state through (the scan's contract), so
+                # the final carry equals the state after `length` real steps
+                y, _, _, (hf, cf) = m.forward(p, s, x, mask=mask,
+                                              initial_state=zeros,
+                                              return_state=True)
+                entry = layers[node.name]
+                z = jnp.zeros((), slot.dtype)
+                layers[node.name] = {
+                    "h": lax.dynamic_update_slice(
+                        entry["h"], hf.astype(entry["h"].dtype), (slot, z)),
+                    "c": lax.dynamic_update_slice(
+                        entry["c"], cf.astype(entry["c"].dtype), (slot, z))}
+            else:
+                y = m.forward(p, s, x, train=False, rng=None, mask=mask)[0]
+            acts[node.name] = y
+        return acts[self.output_name], layers
+
+    def _walk_step(self, params, states, x0, cache, pos, kv_valid):
+        """[slots, 1, f] single-token forward against the cache. `pos` is
+        the per-slot append position (clamped), `kv_valid` the number of
+        valid cache entries including the appended token."""
+        from ..kernels import flash_decode
+        acts = {self.input_name: x0}
+        layers = dict(cache["layers"])
+        for node in self.nodes:
+            if node.kind == "input":
+                continue
+            if node.kind == "vertex":
+                acts[node.name] = node.vertex.apply(
+                    [acts[i] for i in node.inputs])
+                continue
+            m = node.module
+            p, s = params[node.name], states[node.name]
+            x = acts[node.inputs[0]]
+            if isinstance(m, SelfAttentionLayerModule):
+                q, kt, vt = m.project_qkv(p, x)           # [S, 1, H, Dh]
+                entry = layers[node.name]
+                append = jax.vmap(
+                    lambda row, t, at: lax.dynamic_update_slice(
+                        row, t, (at, jnp.zeros((), at.dtype),
+                                 jnp.zeros((), at.dtype))))
+                nk = append(entry["k"], kt.astype(entry["k"].dtype), pos)
+                nv = append(entry["v"], vt.astype(entry["v"].dtype), pos)
+                layers[node.name] = {"k": nk, "v": nv}
+                out = flash_decode(q, nk, nv, kv_valid,
+                                   use_pallas=getattr(m.conf, "use_pallas",
+                                                      False))
+                y = m.finish(p, out.astype(x.dtype), None)
+            elif isinstance(m, _BaseLSTMModule):
+                entry = layers[node.name]
+                y, _, _, (hf, cf) = m.forward(
+                    p, s, x, initial_state=(entry["h"], entry["c"]),
+                    return_state=True)
+                layers[node.name] = {"h": hf.astype(entry["h"].dtype),
+                                     "c": cf.astype(entry["c"].dtype)}
+            else:
+                y = m.forward(p, s, x, train=False, rng=None)[0]
+            acts[node.name] = y
+        return acts[self.output_name], layers
+
+    # ------------------------------------------------------- executables
+    def _one_hot(self, ids):
+        return jax.nn.one_hot(ids, self.vocab, dtype=self._dtype)
+
+    def _build_step(self):
+        C = self.capacity
+
+        def step_fn(params, states, cache, ids):
+            lengths = cache["lengths"]
+            pos = jnp.clip(lengths, 0, C - 1)
+            x0 = self._one_hot(ids[:, None])              # [S, 1, V]
+            y, layers = self._walk_step(params, states, x0, cache,
+                                        pos, pos + 1)
+            probs = y[:, -1].astype(jnp.float32)          # [S, V]
+            new_cache = {"lengths": jnp.minimum(lengths + 1, C),
+                         "layers": layers}
+            return new_cache, jnp.argmax(probs, axis=-1).astype(jnp.int32), \
+                probs
+
+        return jax.jit(step_fn, donate_argnums=(2,))
+
+    def _build_prefill(self, L):
+        def prefill_fn(params, states, cache, slot, ids, length):
+            x0 = self._one_hot(ids[None, :])              # [1, L, V]
+            valid = (jnp.arange(L, dtype=jnp.int32)
+                     < length).astype(self._dtype)[None]  # [1, L]
+            y, layers = self._walk_prefill(params, states, x0, valid,
+                                           cache, slot, length)
+            z = jnp.zeros((), length.dtype)
+            probs = lax.dynamic_slice(
+                y, (z, length - 1, z), (1, 1, self.vocab))[0, 0]
+            probs = probs.astype(jnp.float32)
+            new_cache = {"lengths": cache["lengths"].at[slot].set(length),
+                         "layers": layers}
+            return new_cache, jnp.argmax(probs).astype(jnp.int32), probs
+
+        return jax.jit(prefill_fn, donate_argnums=(2,))
+
+    def _timed(self, fn, label, bucket, *args):
+        """Invoke a decode executable; the first call per label is the XLA
+        compile and is timed into the compile accounting (CompileTracker
+        phase="decode" + jit_compiles_total), same discipline as the
+        batcher's observed buckets."""
+        if label in self._compiled:
+            return fn(*args)
+        t0 = monotonic_s()
+        out = fn(*args)
+        jax.block_until_ready(out[1])
+        ms = (monotonic_s() - t0) * 1000.0
+        self._compiled.add(label)
+        record_jit_compile(label, ms, registry=self.registry)
+        if self.compile_tracker is not None:
+            self.compile_tracker.record(ms, bucket=bucket, phase="decode")
+        return out
+
+    def prefill_bucket(self, n):
+        return bucket_for_len(n, self.capacity)
+
+    def observed_buckets(self):
+        with self._jit_lock:
+            return sorted(self._prefill_fns)
+
+    def executable_counts(self):
+        """{label: XLA cache size} for the compiled decode executables — the
+        hard recompile assertion (a retrace would grow a count past 1)."""
+        out = {}
+        with self._jit_lock:
+            fns = [("decode_step", self._step_fn)] + \
+                [(f"decode_prefill:{L}", f)
+                 for L, f in sorted(self._prefill_fns.items())]
+        for label, fn in fns:
+            if fn is None:
+                continue
+            size = getattr(fn, "_cache_size", None)
+            if callable(size):
+                out[label] = int(size())
+        return out
+
+    # ------------------------------------------------------------- api
+    def prefill(self, cache, slot, prompt_ids):
+        """Run `prompt_ids` (python ints / 1-D array) into cache slot `slot`;
+        returns (cache, first generated id, last-position probs [vocab])."""
+        ids = np.asarray(prompt_ids, np.int32).reshape(-1)
+        n = ids.shape[0]
+        if n < 1:
+            raise ValueError("empty prompt")
+        if n >= self.capacity:
+            raise ValueError(
+                f"prompt of {n} tokens does not fit the cache "
+                f"(capacity {self.capacity}, needs room for >=1 new token)")
+        L = self.prefill_bucket(n)
+        padded = np.zeros((L,), np.int32)
+        padded[:n] = ids
+        with self._jit_lock:
+            fn = self._prefill_fns.get(L)
+            if fn is None:
+                fn = self._prefill_fns[L] = self._build_prefill(L)
+        cache, nid, probs = self._timed(
+            fn, f"decode_prefill:{L}", L, self.model.params,
+            self.model.states, cache, np.int32(slot), padded, np.int32(n))
+        return cache, int(nid), np.asarray(probs)
+
+    def step(self, cache, last_ids):
+        """Advance every slot one token. `last_ids`: [slots] int token ids
+        (inactive slots may carry any id; their outputs are ignored and their
+        cache rows are reset by the next prefill). Returns (cache,
+        next_ids [slots] np.int32, probs [slots, vocab])."""
+        ids = np.asarray(last_ids, np.int32).reshape(self.slots)
+        with self._jit_lock:
+            if self._step_fn is None:
+                self._step_fn = self._build_step()
+            fn = self._step_fn
+        cache, nxt, probs = self._timed(
+            fn, "decode_step", "step", self.model.params, self.model.states,
+            cache, ids)
+        return cache, np.asarray(nxt), np.asarray(probs)
+
+    def warmup(self, buckets=()):
+        """Compile the step and the given prefill buckets on a scratch cache
+        (deploy-time warm-up: a hot-swapped model is never cold)."""
+        cache = self.init_cache()
+        for L in sorted(set(int(b) for b in buckets)):
+            L = min(max(L, MIN_PREFILL_BUCKET), self.capacity)
+            # a (L-1)-token prompt maps to bucket L
+            cache, _, _ = self.prefill(cache, 0, np.zeros((max(L - 1, 1),),
+                                                          np.int32))
+        cache, _, _ = self.step(cache, np.zeros((self.slots,), np.int32))
+        return self
+
+    def generate(self, prompt_ids, max_new_tokens=20, stop_id=None):
+        """Single-request greedy decode on slot 0 (the host loop behind
+        `network.generate`); returns the list of generated token ids."""
+        if int(max_new_tokens) < 1:
+            # same contract as DecodeScheduler.submit: the prefill always
+            # emits one token, so 0 is unservable, not "empty result"
+            raise ValueError("max_new_tokens must be >= 1")
+        cache = self.init_cache()
+        cache, nid, _ = self.prefill(cache, 0, prompt_ids)
+        out = [nid]
+        ids = np.zeros((self.slots,), np.int32)
+        while len(out) < int(max_new_tokens) and out[-1] != stop_id \
+                and len(np.asarray(prompt_ids).reshape(-1)) + len(out) \
+                < self.capacity:
+            ids[0] = out[-1]
+            cache, nxt, _ = self.step(cache, ids)
+            out.append(int(nxt[0]))
+        return out

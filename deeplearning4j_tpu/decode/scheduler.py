@@ -1,0 +1,465 @@
+"""DecodeScheduler: continuous batching over the DecodeEngine's cache slots.
+
+One scheduler thread owns the engine, the live cache, and the slot
+lifecycle; HTTP handler threads only touch the bounded admission queue.
+Every loop iteration:
+
+1. **admit** — free slots are filled from the queue (expired requests fail
+   with DeadlineExceeded instead of burning a prefill). Each admission runs
+   one prefill executable (compiled per pow2 prompt-length bucket) which
+   also emits the request's FIRST token — time-to-first-token is observed
+   on `decode_ttft_ms` with the request's trace id as exemplar.
+2. **step** — one fixed-shape decode step advances EVERY active slot one
+   token; the wall time is each active request's inter-token latency
+   (`decode_itl_ms`). Requests retire per token (max_new_tokens reached,
+   stop id emitted, cache capacity hit, or the per-token deadline budget
+   spent — a deadline mid-generation returns the PARTIAL result with
+   finish_reason="deadline", not an error).
+
+Requests therefore join and leave the in-flight batch per token with zero
+steady-state recompiles: after the step executable and a prompt-length
+bucket have compiled once, no request mix recompiles anything
+(counter-asserted in tests/test_decode.py and tools/smoke_decode.py via
+CompileTracker / jit_compiles_total / the engine's XLA cache sizes).
+
+Hot-swap: the scheduler pins one model version per cache generation. When
+ModelRegistry's active version changes, admission pauses, in-flight
+requests drain on the old engine (a step batch never mixes versions), then
+the engine/cache swap. Engines are cached per model object, and
+`warmup(model)` (wired into ServingServer.deploy) compiles the new
+version's step + observed prefill buckets BEFORE the registry pointer
+swaps — a deploy is never cold, a rollback never recompiles.
+"""
+from __future__ import annotations
+
+import collections
+import threading
+
+from concurrent.futures import Future, TimeoutError as FuturesTimeoutError
+
+from ..serving.admission import (DeadlineExceeded, RejectedError,
+                                 safe_set_exception, safe_set_result)
+from ..serving.registry import NoModelDeployed
+from ..telemetry.trace import current_span, get_tracer
+from ..util.time_source import monotonic_s
+
+
+class GenerateRequest:
+    __slots__ = ("prompt", "max_new_tokens", "stop_id", "future", "deadline",
+                 "enqueued_at", "trace_ctx", "tokens", "slot", "version",
+                 "ttft_ms", "finish_reason")
+
+    def __init__(self, prompt, max_new_tokens, stop_id=None, deadline=None):
+        self.prompt = [int(t) for t in prompt]
+        self.max_new_tokens = int(max_new_tokens)
+        self.stop_id = stop_id
+        self.future = Future()
+        self.deadline = deadline          # absolute monotonic_s() or None
+        self.enqueued_at = monotonic_s()
+        self.trace_ctx = current_span()   # handler thread's span rides along
+        self.tokens = []
+        self.slot = None
+        self.version = None
+        self.ttft_ms = None
+        self.finish_reason = None
+
+    def expired(self, now=None):
+        return self.deadline is not None and \
+            (now if now is not None else monotonic_s()) > self.deadline
+
+    def complete(self):
+        safe_set_result(self.future, {
+            "tokens": list(self.tokens),
+            "n_prompt": len(self.prompt),
+            "version": self.version,
+            "ttft_ms": self.ttft_ms,
+            "finish_reason": self.finish_reason,
+        })
+
+    def fail(self, exc):
+        safe_set_exception(self.future, exc)
+
+
+class DecodeScheduler:
+    def __init__(self, registry, metrics_registry, *, slots=4, max_len=128,
+                 queue_capacity=64, default_max_new_tokens=32, tracer=None,
+                 compile_tracker=None, logger=None, idle_wait_s=0.2,
+                 max_engines=4):
+        self.registry = registry                    # ModelRegistry
+        self.slots = int(slots)
+        self.max_len = int(max_len)
+        self.queue_capacity = int(queue_capacity)
+        self.default_max_new_tokens = int(default_max_new_tokens)
+        self.tracer = tracer if tracer is not None else get_tracer()
+        self.compile_tracker = compile_tracker
+        self.logger = logger
+        self.idle_wait_s = float(idle_wait_s)
+        self.max_engines = int(max_engines)
+        self.metrics_registry = metrics_registry
+
+        self._lock = threading.Lock()
+        self._work = threading.Condition(self._lock)
+        self._queue = collections.deque()
+        self._closed = False
+        self._thread = None
+        # loop-thread-owned state
+        self._engines = collections.OrderedDict()   # id(model) -> (model, eng)
+        self._engine = None
+        self._cache = None
+        self._version = None
+        self._active = {}                           # slot -> GenerateRequest
+        self._free = list(range(self.slots))
+        self._observed_buckets = set()
+
+        reg = metrics_registry
+        self.m_requests = reg.counter("decode_requests_total",
+                                      "Generate requests answered")
+        self.m_tokens = reg.counter("decode_tokens_total",
+                                    "Tokens generated (all requests)")
+        self.m_shed = reg.counter("decode_shed_total",
+                                  "Generate requests shed at admission (429)")
+        self.m_expired = reg.counter(
+            "decode_expired_total",
+            "Generate requests whose deadline passed while queued (504)")
+        self.m_errors = reg.counter("decode_errors_total",
+                                    "Generate requests failed in the engine")
+        self.m_ttft = reg.histogram(
+            "decode_ttft_ms", "Time to first token (admission to first "
+            "token), ms")
+        self.m_itl = reg.histogram(
+            "decode_itl_ms", "Inter-token latency (one decode step), ms")
+        self.m_tps = reg.gauge("decode_tokens_per_sec",
+                               "Decode throughput over the last step wave")
+        reg.gauge("decode_active_slots", "In-flight generate requests",
+                  fn=lambda: float(self.active_count()))
+        reg.gauge("decode_queue_depth", "Generate requests awaiting a slot",
+                  fn=lambda: float(self.depth()))
+        for c in (self.m_requests, self.m_tokens, self.m_shed,
+                  self.m_expired, self.m_errors):
+            c.inc(0)
+
+    # ------------------------------------------------------------ admission
+    def depth(self):
+        with self._lock:
+            return len(self._queue)
+
+    def active_count(self):
+        # loop-thread-written dict; len() is atomic enough for a gauge
+        return len(self._active)
+
+    def submit(self, prompt_ids, max_new_tokens=None, timeout_ms=None,
+               stop_id=None):
+        """Admit one generate request; returns its Future (shed raises
+        RejectedError, an unservable request ValueError)."""
+        max_new = self.default_max_new_tokens if max_new_tokens is None \
+            else int(max_new_tokens)
+        prompt = list(prompt_ids)
+        if not prompt:
+            raise ValueError("empty prompt")
+        if max_new < 1:
+            raise ValueError("max_new_tokens must be >= 1")
+        if len(prompt) + max_new > self.max_len:
+            raise ValueError(
+                f"prompt ({len(prompt)}) + max_new_tokens ({max_new}) "
+                f"exceeds the cache capacity {self.max_len}; split the "
+                "request or deploy with a larger decode_max_len")
+        deadline = None if timeout_ms is None \
+            else monotonic_s() + float(timeout_ms) / 1000.0
+        req = GenerateRequest(prompt, max_new, stop_id=stop_id,
+                              deadline=deadline)
+        with self._work:
+            if self._closed:
+                self.m_shed.add(1)
+                raise RejectedError("server is draining", retry_after_s=5)
+            if len(self._queue) >= self.queue_capacity:
+                self.m_shed.add(1)
+                raise RejectedError(
+                    f"decode queue full ({self.queue_capacity} pending)",
+                    retry_after_s=1)
+            self._queue.append(req)
+            self._work.notify()
+        return req.future
+
+    def generate(self, prompt_ids, max_new_tokens=None, timeout_ms=None,
+                 stop_id=None, wait_s=120.0):
+        """Blocking convenience: submit + wait; a wait timeout abandons the
+        request so it cannot burn a slot generating tokens nobody reads."""
+        fut = self.submit(prompt_ids, max_new_tokens=max_new_tokens,
+                          timeout_ms=timeout_ms, stop_id=stop_id)
+        try:
+            return fut.result(timeout=wait_s)
+        except FuturesTimeoutError:
+            self.abandon(fut)
+            raise
+
+    def abandon(self, future):
+        """Best-effort cancellation of a request whose caller gave up: a
+        still-queued request is withdrawn and failed; an in-flight one has
+        its token budget clamped so it retires at the next step instead of
+        generating a full answer nobody will read."""
+        with self._lock:
+            for r in list(self._queue):
+                if r.future is future:
+                    self._queue.remove(r)
+                    r.fail(RejectedError("abandoned by caller"))
+                    return True
+        for r in list(self._active.values()):   # loop-thread-owned; the
+            if r.future is future:              # int write is benign
+                r.max_new_tokens = 0
+                return True
+        return False
+
+    # ------------------------------------------------------------ lifecycle
+    def start(self):
+        if self._thread is not None and self._thread.is_alive():
+            return self
+        self._closed = False
+        self._thread = threading.Thread(target=self._run, daemon=True,
+                                        name="decode-scheduler")
+        self._thread.start()
+        return self
+
+    def stop(self, drain=True, timeout=30.0):
+        """Stop admitting and exit once in-flight work finishes. drain=True
+        (default) also serves what is already queued; drain=False sheds the
+        queue with RejectedError (in-flight generations still run to their
+        own finish — they are bounded by max_new_tokens)."""
+        with self._work:
+            self._closed = True
+            if not drain:
+                queued, self._queue = list(self._queue), collections.deque()
+            else:
+                queued = []
+            self._work.notify_all()
+        for r in queued:
+            r.fail(RejectedError("server shutting down"))
+        if self._thread is not None:
+            self._thread.join(timeout)
+
+    def probe(self):
+        """HealthMonitor probe: unhealthy when the loop thread died."""
+        t = self._thread
+        if t is None:
+            return "degraded", {"reason": "not started"}
+        if not t.is_alive() and not self._closed:
+            return "unhealthy", {"reason": "decode loop dead"}
+        return "healthy", {"active": self.active_count(),
+                           "queued": self.depth(),
+                           "version": self._version}
+
+    def snapshot(self):
+        """JSON block for the serving /metrics snapshot."""
+        with self._lock:     # _observed_buckets is written under this lock
+            buckets = sorted(self._observed_buckets)
+        return {
+            "requests": self.m_requests.get(),
+            "tokens": self.m_tokens.get(),
+            "shed": self.m_shed.get(),
+            "expired": self.m_expired.get(),
+            "errors": self.m_errors.get(),
+            "active_slots": self.active_count(),
+            "queue_depth": self.depth(),
+            "tokens_per_sec": self.m_tps.get(),
+            "ttft_ms": self.m_ttft.percentiles(),
+            "itl_ms": self.m_itl.percentiles(),
+            "version": self._version,
+            "prefill_buckets": buckets,
+        }
+
+    # ------------------------------------------------------------- engines
+    def engine_for(self, model):
+        """One DecodeEngine per model object, LRU-bounded — a rollback to a
+        recently-served version reuses its compiled executables."""
+        from .engine import DecodeEngine
+        key = id(model)
+        with self._lock:
+            hit = self._engines.get(key)
+            if hit is not None and hit[0] is model:
+                self._engines.move_to_end(key)
+                return hit[1]
+        eng = DecodeEngine(model, slots=self.slots, max_len=self.max_len,
+                           compile_tracker=self.compile_tracker,
+                           registry=self.metrics_registry)
+        with self._lock:
+            self._engines[key] = (model, eng)
+            self._engines.move_to_end(key)
+            while len(self._engines) > self.max_engines:
+                self._engines.popitem(last=False)
+        return eng
+
+    def warmup(self, model):
+        """Deploy-time warm-up: compile the step + every observed prompt
+        bucket for `model` BEFORE the registry pointer swaps."""
+        with self._lock:
+            buckets = set(self._observed_buckets)
+        self.engine_for(model).warmup(buckets)
+
+    # ------------------------------------------------------------ the loop
+    def _run(self):
+        while True:
+            with self._work:
+                while not self._queue and not self._active \
+                        and not self._closed:
+                    self._work.wait(self.idle_wait_s)
+                if self._closed and not self._queue and not self._active:
+                    return
+            try:
+                self._admit()
+                self._step_wave()
+            except Exception as e:          # last resort: the loop survives
+                self._fail_all(e)
+
+    def _fail_all(self, exc):
+        self.m_errors.add(len(self._active))
+        for slot, r in list(self._active.items()):
+            r.fail(exc)
+            self._free.append(slot)
+        self._active.clear()
+        self._cache = None                  # poisoned (possibly donated away)
+        if self.logger is not None:
+            self.logger.error("decode_wave_failed",
+                              error=f"{type(exc).__name__}: {exc}")
+
+    def _pop_queued(self):
+        with self._lock:
+            if self._queue:
+                return self._queue.popleft()
+            return None
+
+    def _admit(self):
+        if not self._free:
+            return
+        # pin ONE (version, model) per cache generation; on a hot-swap,
+        # drain in-flight work before re-pinning (a step never mixes
+        # versions)
+        try:
+            entry = self.registry.active_entry()
+        except NoModelDeployed as e:
+            while True:
+                r = self._pop_queued()
+                if r is None:
+                    return
+                r.fail(e)
+            return
+        if self._engine is None or self._version != entry.version \
+                or self._engine.model is not entry.model:
+            if self._active:
+                return                      # drain first, swap next wave
+            try:
+                self._engine = self.engine_for(entry.model)
+            except Exception as e:
+                # a model with no decode semantics (DecodeUnsupported) — or
+                # any engine-build failure — is deterministic for this
+                # version: fail EVERYTHING queued and stop, instead of
+                # leaving the queue full and the loop spinning on it
+                if self.logger is not None:
+                    self.logger.error(
+                        "decode_engine_unavailable", version=entry.version,
+                        error=f"{type(e).__name__}: {e}")
+                while True:
+                    r = self._pop_queued()
+                    if r is None:
+                        return
+                    self.m_errors.add(1)
+                    r.fail(e)
+            self._version = entry.version
+            self._cache = self._engine.init_cache()
+        if self._cache is None:
+            self._cache = self._engine.init_cache()
+        while self._free:
+            r = self._pop_queued()
+            if r is None:
+                return
+            now = monotonic_s()
+            if r.expired(now):
+                self.m_expired.add(1)
+                r.fail(DeadlineExceeded(
+                    "deadline exceeded while awaiting a decode slot"))
+                continue
+            slot = self._free.pop()
+            r.slot, r.version = slot, self._version
+            bucket = self._engine.prefill_bucket(len(r.prompt))
+            with self._lock:
+                self._observed_buckets.add(bucket)
+            with self.tracer.span("decode_prefill", parent=r.trace_ctx,
+                                  slot=slot, bucket=bucket,
+                                  n_prompt=len(r.prompt)):
+                try:
+                    self._cache, nid, _ = self._engine.prefill(
+                        self._cache, slot, r.prompt)
+                except Exception as e:
+                    self.m_errors.add(1)
+                    r.fail(e)
+                    self._free.append(slot)
+                    if self.logger is not None:
+                        self.logger.error(
+                            "decode_prefill_failed", slot=slot,
+                            error=f"{type(e).__name__}: {e}")
+                    # the prefill DONATES the whole cache: after a failure
+                    # mid-execution the co-batched slots' buffers may be
+                    # gone too, so fail them loudly rather than stepping a
+                    # poisoned cache next wave; a fresh cache re-inits on
+                    # the next admission
+                    if self._active:
+                        self._fail_all(RuntimeError(
+                            "co-batched KV cache lost to a failed prefill: "
+                            f"{type(e).__name__}: {e}"))
+                    else:
+                        self._cache = None
+                    return
+            now = monotonic_s()
+            r.ttft_ms = (now - r.enqueued_at) * 1000.0
+            self.m_ttft.observe(r.ttft_ms,
+                                trace_id=getattr(r.trace_ctx, "trace_id",
+                                                 None))
+            r.tokens.append(int(nid))
+            self.m_tokens.add(1)
+            self._active[slot] = r
+            self._maybe_retire(slot, now)
+
+    def _step_wave(self):
+        if not self._active:
+            return
+        import numpy as np
+        ids = np.zeros((self.slots,), np.int32)
+        for slot, r in self._active.items():
+            ids[slot] = r.tokens[-1]
+        t0 = monotonic_s()
+        self._cache, nxt, _ = self._engine.step(self._cache, ids)
+        wall = monotonic_s() - t0
+        n_active = len(self._active)
+        self.m_tps.set(n_active / max(wall, 1e-9))
+        now = monotonic_s()
+        for slot, r in list(self._active.items()):
+            r.tokens.append(int(nxt[slot]))
+            self.m_tokens.add(1)
+            self.m_itl.observe(wall * 1000.0,
+                               trace_id=getattr(r.trace_ctx, "trace_id",
+                                                None))
+            self._maybe_retire(slot, now)
+
+    def _maybe_retire(self, slot, now):
+        r = self._active.get(slot)
+        if r is None:
+            return
+        reason = None
+        if r.stop_id is not None and r.tokens and r.tokens[-1] == r.stop_id:
+            reason = "stop"
+        elif len(r.tokens) >= r.max_new_tokens:
+            reason = "length"
+        elif len(r.prompt) + len(r.tokens) >= self.max_len:
+            reason = "capacity"
+        elif r.expired(now):
+            # the per-token deadline budget: the client gets what was
+            # generated before the budget ran out, marked as such
+            reason = "deadline"
+        if reason is None:
+            return
+        r.finish_reason = reason
+        self._active.pop(slot, None)
+        self._free.append(slot)
+        self.m_requests.add(1)
+        r.complete()
+        if self.logger is not None:
+            self.logger.debug("generate_done", slot=slot, reason=reason,
+                              n_tokens=len(r.tokens), version=r.version)

@@ -51,6 +51,14 @@ NEG_INF = -1e30
 LANES = 128  # lse/delta residuals are stored broadcast over one lane tile
 
 
+def _compiler_params(pltpu, **kw):
+    """jax renamed TPUCompilerParams -> CompilerParams across the versions
+    this repo spans; resolve whichever this install has."""
+    cls = getattr(pltpu, "CompilerParams", None) \
+        or getattr(pltpu, "TPUCompilerParams")
+    return cls(**kw)
+
+
 def _mask_fold(s, km_ref):
     """Fold the [1, block_k] key-validity row (the BlockSpec index map
     already selected this key block) into the score tile — broadcasts over
@@ -206,8 +214,8 @@ def _flash_forward(q, k, v, km, offs, scale, causal, block_q, block_k,
             pltpu.VMEM((block_q, LANES), jnp.float32),   # running max
             pltpu.VMEM((block_q, LANES), jnp.float32),   # running sum
         ],
-        compiler_params=pltpu.CompilerParams(
-            dimension_semantics=("parallel", "parallel", "arbitrary")),
+        compiler_params=_compiler_params(
+            pltpu, dimension_semantics=("parallel", "parallel", "arbitrary")),
         interpret=interpret,
     )(*args)
     out = res[0]
@@ -371,8 +379,8 @@ def _flash_backward(q, k, v, out, lse, g, km, offs, scale, causal, block_q,
         out_specs=pl.BlockSpec((1, block_q, D), lambda b, qi, ki: (b, qi, 0)),
         out_shape=jax.ShapeDtypeStruct((B * H, Tq, D), q.dtype),
         scratch_shapes=[pltpu.VMEM((block_q, D), jnp.float32)],
-        compiler_params=pltpu.CompilerParams(
-            dimension_semantics=("parallel", "parallel", "arbitrary")),
+        compiler_params=_compiler_params(
+            pltpu, dimension_semantics=("parallel", "parallel", "arbitrary")),
         interpret=interpret,
     )(qf, kf, vf, dof, lse, delta, *extra_args)
 
@@ -400,8 +408,8 @@ def _flash_backward(q, k, v, out, lse, g, km, offs, scale, causal, block_q,
         ],
         scratch_shapes=[pltpu.VMEM((block_k, D), jnp.float32),
                         pltpu.VMEM((block_k, D), jnp.float32)],
-        compiler_params=pltpu.CompilerParams(
-            dimension_semantics=("parallel", "parallel", "arbitrary")),
+        compiler_params=_compiler_params(
+            pltpu, dimension_semantics=("parallel", "parallel", "arbitrary")),
         interpret=interpret,
     )(qf, kf, vf, dof, lse, delta, *extra_args)
 
@@ -574,6 +582,67 @@ def flash_attention_lse(q, k, v, *, causal=False, scale=None, key_mask=None,
              jnp.asarray(0 if k_offset is None else k_offset, jnp.int32)])
     return _flash_lse(q, k, v, km, offs, scale, causal, plan[0], plan[1],
                       interpret)
+
+
+def _decode_reference(q, k, v, lengths, scale):
+    """Masked single-query attention, materializing the [S, H, 1, C] score
+    row — the fallback (and CPU-test) semantics flash_decode must match.
+    A slot with lengths=0 degrades to the uniform average over the cache,
+    same contract as the main kernel's fully-masked-row behavior; callers
+    never read those slots."""
+    S, C = k.shape[0], k.shape[1]
+    valid = jax.lax.broadcasted_iota(jnp.int32, (S, C), 1) \
+        < jnp.asarray(lengths, jnp.int32)[:, None]
+    s = jnp.einsum("sqhd,schd->shqc", q.astype(jnp.float32),
+                   k.astype(jnp.float32)) * scale
+    s = jnp.where(valid[:, None, None, :], s, NEG_INF)
+    p = jax.nn.softmax(s, axis=-1)
+    out = jnp.einsum("shqc,schd->sqhd", p, v.astype(jnp.float32))
+    return out.astype(q.dtype)
+
+
+def flash_decode(q, k, v, lengths, *, scale=None, use_pallas=True,
+                 block_k=1024, interpret=None):
+    """Decode-mode flash attention: ONE new query per cache slot against a
+    fixed-shape slot-per-request KV cache.
+
+    q: [slots, 1, heads, head_dim] — the current token's query (its k/v
+    already appended to the cache at position lengths-1);
+    k, v: [slots, capacity, heads, head_dim] — the cache;
+    lengths: [slots] int32 — valid entries per slot (including the current
+    token). Returns [slots, 1, heads, head_dim].
+
+    The per-slot validity mask (iota < lengths) folds into the score tiles
+    exactly like the key mask of the training kernel — this is the same
+    in-kernel masking discipline, driven by the cache's length vector, so
+    every decode step runs ONE executable regardless of how many tokens
+    each co-batched request has generated (the zero-recompile contract of
+    the decode engine). A [1, D] query doesn't meet Mosaic's 8-sublane
+    floor when compiled, so the query row is broadcast to 8 sublanes and
+    row 0 of the output kept: decode attention is bound by streaming the
+    K/V cache bytes from HBM, and the 7 redundant MXU rows ride along for
+    free. Falls back to the masked reference row when shapes don't tile or
+    `use_pallas=False` (the two paths agree to f32 rounding)."""
+    S, Tq, H, D = q.shape
+    assert Tq == 1, f"flash_decode takes one query per slot, got Tq={Tq}"
+    C = k.shape[1]
+    if scale is None:
+        scale = float(1.0 / (D ** 0.5))
+    if interpret is None:
+        interpret = jax.default_backend() != "tpu"
+    lengths = jnp.asarray(lengths, jnp.int32)
+    if not use_pallas:
+        return _decode_reference(q, k, v, lengths, scale)
+    tq = 1 if interpret else 8          # Mosaic sublane floor when compiled
+    plan = _plan(tq, C, D, tq, block_k, interpret)
+    if plan is None:
+        return _decode_reference(q, k, v, lengths, scale)
+    km = (jax.lax.broadcasted_iota(jnp.int32, (S, C), 1)
+          < lengths[:, None]).astype(jnp.float32)[:, None, :]   # [S, 1, C]
+    qq = q if tq == 1 else jnp.broadcast_to(q, (S, tq, H, D))
+    out = _flash(qq, k, v, km, None, scale, False, plan[0], plan[1],
+                 interpret)
+    return out[:, :1]
 
 
 def can_flash(Tq, Tk, D, *, block_q=256, block_k=1024, interpret=None):

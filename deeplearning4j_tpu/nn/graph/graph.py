@@ -497,19 +497,26 @@ class ComputationGraph(MultiStepTrainable):
         self.score_value = jnp.mean(jnp.stack(scores))
 
     # ------------------------------------------------------------ inference
-    def output(self, *inputs, train=False):
-        """(reference: ComputationGraph.output / outputSingle)"""
+    def output(self, *inputs, train=False, mask=None):
+        """(reference: ComputationGraph.output / outputSingle). `mask` is a
+        [batch, time] validity mask for the FIRST network input (the
+        serving batcher's padded+masked length buckets)."""
         if self.params is None:
             self.init()
         inputs = [jnp.asarray(x) for x in inputs]
-        key = ("output", len(inputs))
+        masked = mask is not None
+        key = ("output", len(inputs), masked)
         if key not in self._jit_cache:
-            def fwd(params, states, xs):
+            def fwd(params, states, xs, mm):
                 params, xs = self._cast_for_compute(params, xs)
-                acts, _, _, _ = self._forward(params, states, xs, train=False, rng=None)
+                masks = None if mm is None else [mm] + [None] * (len(xs) - 1)
+                acts, _, _, _ = self._forward(params, states, xs, train=False,
+                                              rng=None, masks=masks)
                 return [acts[o].astype(self._dtype) for o in self.conf.network_outputs]
             self._jit_cache[key] = jax.jit(fwd)
-        outs = self._jit_cache[key](self.params, self.states, inputs)
+        outs = self._jit_cache[key](
+            self.params, self.states, inputs,
+            None if mask is None else jnp.asarray(mask, self._dtype))
         return outs[0] if len(outs) == 1 else outs
 
     def feed_forward(self, *inputs, train=False):
@@ -559,6 +566,9 @@ class ComputationGraph(MultiStepTrainable):
 
     def rnn_clear_previous_state(self):
         self._rnn_state = {}
+
+    # generate() — greedy KV-cache decode — lives on MultiStepTrainable
+    # (shared with MultiLayerNetwork, like set_update_sharding)
 
     def _zero_carries(self, batch):
         carries = {}

@@ -200,21 +200,25 @@ class SelfAttentionLayerModule(BaseLayerModule):
         }
         return params, {}, InputType.recurrent(n_out)
 
-    def forward(self, params, state, x, *, train=False, rng=None, mask=None):
-        from ...parallel.ring_attention import attention_reference, \
-            blockwise_attention
+    def project_qkv(self, params, x):
+        """[b,t,f] -> (q, k, v) each [b,t,H,Dh]. Split out of forward so the
+        decode engine (decode/engine.py) can run the SAME projections when
+        it appends one token's k/v to a KV-cache slot."""
         c = self.conf
-        attn_rng = None
-        attn_drop = getattr(c, "attention_dropout", 0.0) or 0.0
-        if rng is not None and attn_drop > 0:
-            rng, attn_rng = jax.random.split(rng)
-        x = apply_dropout(x, c.dropout, train, rng)
         B, T, _ = x.shape
         H = int(c.n_heads)
         Dh = int(c.n_out) // H
         q = (x @ params["Wq"]).reshape(B, T, H, Dh)
         k = (x @ params["Wk"]).reshape(B, T, H, Dh)
         v = (x @ params["Wv"]).reshape(B, T, H, Dh)
+        return q, k, v
+
+    def attend(self, q, k, v, mask):
+        """The kernel dispatch (shared by forward and the decode prefill)."""
+        from ...parallel.ring_attention import attention_reference, \
+            blockwise_attention
+        c = self.conf
+        T = q.shape[1]
         if getattr(c, "use_pallas", False):
             from ...kernels import flash_attention
             # block_size tunes the QUERY tile only; the key tile keeps the
@@ -223,17 +227,32 @@ class SelfAttentionLayerModule(BaseLayerModule):
             # at T=4096 on a real v5e). Key masks fold into the kernel's
             # score tiles (fwd + both bwd), so ragged/packed batches keep
             # the fast path; untileable shapes fall back inside the call
-            out = flash_attention(q, k, v, causal=c.causal,
-                                  block_q=int(c.block_size), key_mask=mask)
-        elif T % min(int(c.block_size), T) == 0:
-            out = blockwise_attention(q, k, v, block_size=int(c.block_size),
-                                      causal=c.causal, key_mask=mask)
-        else:
-            out = attention_reference(q, k, v, causal=c.causal,
-                                      key_mask=mask)
-        out = apply_dropout(out, attn_drop, train, attn_rng)
+            return flash_attention(q, k, v, causal=c.causal,
+                                   block_q=int(c.block_size), key_mask=mask)
+        if T % min(int(c.block_size), T) == 0:
+            return blockwise_attention(q, k, v, block_size=int(c.block_size),
+                                       causal=c.causal, key_mask=mask)
+        return attention_reference(q, k, v, causal=c.causal, key_mask=mask)
+
+    def finish(self, params, out, mask):
+        """Output projection + activation + mask zeroing on the attention
+        context [b,t,H,Dh] (shared by forward and both decode legs)."""
+        c = self.conf
+        B, T = out.shape[0], out.shape[1]
         out = out.reshape(B, T, int(c.n_out)) @ params["Wo"] + params["b"]
         out = self.activation_fn()(out)
         if mask is not None:
             out = out * mask[:, :, None]  # zero masked steps like the LSTM scan
-        return out, state, mask
+        return out
+
+    def forward(self, params, state, x, *, train=False, rng=None, mask=None):
+        c = self.conf
+        attn_rng = None
+        attn_drop = getattr(c, "attention_dropout", 0.0) or 0.0
+        if rng is not None and attn_drop > 0:
+            rng, attn_rng = jax.random.split(rng)
+        x = apply_dropout(x, c.dropout, train, rng)
+        q, k, v = self.project_qkv(params, x)
+        out = self.attend(q, k, v, mask)
+        out = apply_dropout(out, attn_drop, train, attn_rng)
+        return self.finish(params, out, mask), state, mask

@@ -588,26 +588,33 @@ class MultiLayerNetwork(MultiStepTrainable):
         return carries
 
     # ------------------------------------------------------------ inference
-    def output(self, x, train=False):
+    def output(self, x, train=False, mask=None):
         """Full forward pass (reference: output :1462). Jitted per input shape.
         train=True uses train-mode semantics (batch statistics for BN); dropout
-        stays off because no rng is threaded through inference."""
+        stays off because no rng is threaded through inference. `mask`
+        ([batch, time] validity for 3-D sequence inputs) flows to every layer
+        like in training — the serving batcher's padded+masked length buckets
+        ride through here."""
         if self.params is None:
             self.init()
         x = jnp.asarray(x)
-        key = ("output", bool(train))
+        masked = mask is not None
+        key = ("output", bool(train), masked)
         if key not in self._jit_cache:
             is_train = bool(train)
 
-            def fwd(params, states, xx):
+            def fwd(params, states, xx, mm):
                 params, xx = self._cast_for_compute(
                     params, xx, keep_f32=(str(len(self.layers) - 1),))
-                out, _, _, _, _ = self._forward(params, states, xx, train=is_train,
-                                                rng=None)
+                out, _, _, _, _ = self._forward(params, states, xx,
+                                                train=is_train, rng=None,
+                                                mask=mm)
                 return out.astype(self._dtype)
             self._jit_cache[key] = timed_first_call(
-                jax.jit(fwd), f"output:train={bool(train)}")
-        return self._jit_cache[key](self.params, self.states, x)
+                jax.jit(fwd), f"output:train={bool(train)},mask={masked}")
+        return self._jit_cache[key](
+            self.params, self.states, x,
+            None if mask is None else jnp.asarray(mask, self._dtype))
 
     def feed_forward(self, x, train=False):
         """Per-layer activations list (reference: feedForward)."""
@@ -672,6 +679,9 @@ class MultiLayerNetwork(MultiStepTrainable):
 
     def rnn_set_previous_state(self, layer_idx, state):
         self._rnn_state[str(layer_idx)] = state
+
+    # generate() — greedy KV-cache decode — lives on MultiStepTrainable
+    # (shared with ComputationGraph, like set_update_sharding)
 
     # ------------------------------------------------------------ pretrain
     def pretrain(self, data, epochs=1):

@@ -17,8 +17,10 @@ Three cooperating pieces in front of the jitted `model.output` hot path:
   sheds immediately (HTTP 429 + Retry-After) instead of queueing unbounded
   latency, and shutdown drains gracefully.
 
-`ServingServer` is the HTTP front-end (/predict, /models, /deploy,
-/rollback, /metrics, /trace, /healthz) on the shared util/http plumbing;
+`ServingServer` is the HTTP front-end (/predict, /generate, /models,
+/deploy, /rollback, /metrics, /trace, /healthz) on the shared util/http
+plumbing; `decode=True` attaches the autoregressive decode plane (decode/:
+KV-cache continuous batching behind POST /generate);
 metrics live in a telemetry.MetricsRegistry (JSON snapshot at /metrics,
 Prometheus text with ?format=prometheus, XLA compile accounting via
 CompileTracker, ui/storage stats-tier routing), and every /predict is

@@ -51,9 +51,10 @@ class DeadlineExceeded(RuntimeError):
 
 class Request:
     __slots__ = ("x", "future", "deadline", "enqueued_at",
-                 "count_as_request", "trace_ctx")
+                 "count_as_request", "trace_ctx", "seq_bucket")
 
-    def __init__(self, x, deadline=None, count_as_request=True):
+    def __init__(self, x, deadline=None, count_as_request=True,
+                 seq_bucket=False):
         self.x = x
         self.future = Future()
         self.deadline = deadline          # absolute monotonic_s() or None
@@ -61,6 +62,11 @@ class Request:
         # chunks of one oversized client request set this on the first chunk
         # only, so metrics.requests counts client calls, not chunks
         self.count_as_request = count_as_request
+        # sequence-length bucketing: a [rows, T, feat] request whose T may be
+        # padded+masked up to a power-of-two bucket, so requests of DIFFERENT
+        # lengths coalesce into one batch (the server opts 3-D requests in
+        # when its model takes an output mask)
+        self.seq_bucket = bool(seq_bucket) and x.ndim == 3
         # the handler thread's active span (if any) rides along, so the
         # batcher thread can parent its admission/batch/dispatch spans under
         # the originating request — this IS the propagated trace context
@@ -69,6 +75,10 @@ class Request:
     @property
     def rows(self):
         return int(self.x.shape[0])
+
+    @property
+    def timesteps(self):
+        return int(self.x.shape[1]) if self.x.ndim >= 3 else None
 
     def complete(self, result):
         safe_set_result(self.future, result)
@@ -79,7 +89,11 @@ class Request:
     @property
     def signature(self):
         """Batchable key: trailing (per-example) shape + dtype. Only
-        same-signature requests may share a padded batch."""
+        same-signature requests may share a padded batch. A seq-bucketed
+        request drops the time dim from the key — requests of different
+        sequence lengths coalesce, padded+masked to one length bucket."""
+        if self.seq_bucket:
+            return ("seq", tuple(self.x.shape[2:]), str(self.x.dtype))
         return (tuple(self.x.shape[1:]), str(self.x.dtype))
 
     def expired(self, now=None):

@@ -18,6 +18,7 @@ output back to per-request futures, and records metrics.
 """
 from __future__ import annotations
 
+import inspect
 import threading
 
 import numpy as np
@@ -44,6 +45,7 @@ class DynamicBatcher:
         self.max_latency_ms = float(max_latency_ms)
         self.observed = set()         # (signature, bucket) pairs dispatched
         self._obs_lock = threading.Lock()
+        self._mask_ok = {}            # id(model) -> (model, takes-mask bool)
         self._thread = None
         # telemetry: spans per dispatch (parented under the originating
         # request's propagated context) + XLA compile accounting — the first
@@ -89,6 +91,24 @@ class DynamicBatcher:
         batch = [r for r in batch if not r.future.done()]
         if not batch:
             return
+        if batch[0].seq_bucket:
+            try:
+                model = self.registry.active_entry().model
+            except Exception:
+                model = None     # no model: the failure path below reports
+            if model is not None and not self._accepts_mask(model):
+                # duck-typed model whose output() takes no mask: demote to
+                # legacy per-length dispatches (no cross-length coalescing)
+                # instead of failing 100% of its 3-D requests on a
+                # TypeError — previously-working custom models keep working
+                for r in batch:
+                    r.seq_bucket = False
+                groups = {}
+                for r in batch:
+                    groups.setdefault(r.timesteps, []).append(r)
+                for group in groups.values():
+                    self._dispatch(group)
+                return
         taken_at = monotonic_s()
         tracer = self.tracer
         # ONE batch span per coalesced dispatch, root of its OWN trace: the
@@ -115,13 +135,46 @@ class DynamicBatcher:
             # preprocessing (a zip's normalizer) can never mix across a swap
             entry = self.registry.active_entry()
             version, model = entry.version, entry.model
+            seq = batch[0].seq_bucket     # signature-homogeneous batch
             rows = sum(r.rows for r in batch)
             bucket = bucket_for(rows)
-            x = batch[0].x if len(batch) == 1 else \
-                np.concatenate([r.x for r in batch], axis=0)
+            mask = None
+            if seq:
+                # padded+masked sequence-length bucketing: pad every request
+                # along time up to ONE power-of-two length bucket and ship a
+                # [rows, len_bucket] validity mask, so requests of DIFFERENT
+                # prompt lengths share a batch AND a compiled executable —
+                # the executable set is bounded by (batch buckets) x (length
+                # buckets), not by the lengths clients happen to send
+                len_bucket = bucket_for(max(r.timesteps for r in batch))
+                parts, mparts = [], []
+                for r in batch:
+                    t = r.timesteps
+                    xr = r.x
+                    if t < len_bucket:
+                        pad = np.zeros(
+                            (xr.shape[0], len_bucket - t) + xr.shape[2:],
+                            dtype=xr.dtype)
+                        xr = np.concatenate([xr, pad], axis=1)
+                    parts.append(xr)
+                    mr = np.zeros((xr.shape[0], len_bucket), np.float32)
+                    mr[:, :t] = 1.0
+                    mparts.append(mr)
+                x = parts[0] if len(parts) == 1 else \
+                    np.concatenate(parts, axis=0)
+                mask = mparts[0] if len(mparts) == 1 else \
+                    np.concatenate(mparts, axis=0)
+                self.metrics.record_seq_bucket(len_bucket)
+            else:
+                x = batch[0].x if len(batch) == 1 else \
+                    np.concatenate([r.x for r in batch], axis=0)
             if bucket > rows:
                 pad = np.zeros((bucket - rows,) + x.shape[1:], dtype=x.dtype)
                 x = np.concatenate([x, pad], axis=0)
+                if mask is not None:    # pad rows: every position invalid
+                    mask = np.concatenate(
+                        [mask, np.zeros((bucket - rows, mask.shape[1]),
+                                        np.float32)], axis=0)
             if entry.transform is not None:
                 # shape-preserving (normalizers are per-element affine); the
                 # normalizer's own float32 output dtype flows through —
@@ -135,15 +188,21 @@ class DynamicBatcher:
             # model actually sees: warmup() replays these, so a hot-swapped
             # version compiles the executable dispatch will really use (a
             # raw-request key would warm an executable serving never runs
-            # whenever the transform changes the dtype)
-            key = ((tuple(x.shape[1:]), str(x.dtype)), bucket)
+            # whenever the transform changes the dtype). Seq batches key on
+            # (batch bucket, length bucket) — warm-up replays the mask too
+            if mask is not None:
+                key = (("seq",) + (tuple(x.shape[2:]), str(x.dtype)),
+                       bucket, x.shape[1])
+            else:
+                key = ((tuple(x.shape[1:]), str(x.dtype)), bucket)
             with self._obs_lock:
                 first_dispatch = key not in self.observed
             dispatch_span = tracer.start_span(
                 "dispatch", parent=batch_span, bucket=bucket, rows=rows,
                 compiled=first_dispatch)
             t0 = monotonic_s()
-            out = np.asarray(model.output(x))
+            out = np.asarray(model.output(x) if mask is None
+                             else model.output(x, mask=mask))
             dispatch_ms = (monotonic_s() - t0) * 1000.0
             if entry.transform is not None:
                 # regression models fitted with fit_labels=True predict in
@@ -177,14 +236,41 @@ class DynamicBatcher:
         batch_span.set_attribute("bucket", bucket).end(now)
         offset = 0
         for r in batch:
-            r.complete({"prediction": out[offset:offset + r.rows],
-                        "version": version})
+            pred = out[offset:offset + r.rows]
+            if seq and pred.ndim >= 3 and pred.shape[1] == x.shape[1]:
+                # time-distributed ([rows, T, out]) output: hand back only
+                # the request's own (unpadded) timesteps; pooled 2-D outputs
+                # pass through whole (ndim check keeps an n_out that happens
+                # to equal the length bucket from being mis-sliced)
+                pred = pred[:, :r.timesteps]
+            r.complete({"prediction": pred, "version": version})
             # exemplar: the request's own trace id rides with its latency
             # observation (batcher thread has no current span of its own)
             self.metrics.record_latency(
                 (now - r.enqueued_at) * 1000.0,
                 trace_id=getattr(r.trace_ctx, "trace_id", None))
             offset += r.rows
+
+    def _accepts_mask(self, model):
+        """Whether model.output takes a `mask` kwarg (both nn network types
+        do; duck-typed stand-ins may not). Cached per model object, bounded
+        — the (model, flag) tuple pins the object so a recycled id() can
+        never serve a stale answer."""
+        key = id(model)
+        hit = self._mask_ok.get(key)
+        if hit is not None and hit[0] is model:
+            return hit[1]
+        try:
+            params = inspect.signature(model.output).parameters
+            ok = "mask" in params or any(
+                p.kind == inspect.Parameter.VAR_KEYWORD
+                for p in params.values())
+        except (TypeError, ValueError):
+            ok = False
+        self._mask_ok[key] = (model, ok)
+        while len(self._mask_ok) > 8:     # a handful of live versions
+            self._mask_ok.pop(next(iter(self._mask_ok)))
+        return ok
 
     def reset_observed(self):
         """Forget recorded (signature, bucket) pairs — used when the serving
@@ -195,17 +281,27 @@ class DynamicBatcher:
     # ---- warm-up (used by registry deploy/rollback) ------------------------
     def warmup(self, model):
         """Compile `model`'s executables for every (signature, bucket) this
-        batcher has dispatched, so a hot-swapped version is never cold.
-        Warm-up compiles are real XLA compiles and are accounted as such
-        (labeled phase="warmup"), keeping deploy cost visible."""
+        batcher has dispatched, so a hot-swapped version is never cold —
+        seq batches replay their (batch bucket, length bucket) pair WITH a
+        mask, the executable dispatch really uses. Warm-up compiles are real
+        XLA compiles and are accounted as such (labeled phase="warmup"),
+        keeping deploy cost visible."""
         with self._obs_lock:
             observed = sorted(self.observed,
                               key=lambda sb: (str(sb[0]), sb[1]))
-        for (shape, dtype), bucket in observed:
-            zeros = np.zeros((bucket,) + tuple(shape), dtype=dtype)
+        for key in observed:
+            if len(key) == 3:            # (("seq", feat, dtype), bucket, L)
+                (_, feat, dtype), bucket, L = key
+                zeros = np.zeros((bucket, L) + tuple(feat), dtype=dtype)
+                mask = np.ones((bucket, L), np.float32)
+                call = lambda: np.asarray(model.output(zeros, mask=mask))
+            else:
+                (shape, dtype), bucket = key
+                zeros = np.zeros((bucket,) + tuple(shape), dtype=dtype)
+                call = lambda: np.asarray(model.output(zeros))
             with self.tracer.span("warmup_compile", bucket=bucket):
                 t0 = monotonic_s()
-                np.asarray(model.output(zeros))  # block until compiled + run
+                call()                   # block until compiled + run
                 if self.compile_tracker is not None:
                     self.compile_tracker.record(
                         (monotonic_s() - t0) * 1000.0, bucket=bucket,

@@ -38,8 +38,10 @@ user traffic:
   off to `serving.canary.CanaryController` (alert-gated promote/rollback);
   see that module.
 
-Endpoints: POST /predict /deploy /rollback; GET /healthz /metrics
-(?format=prometheus) /replicas /alerts /logs /trace.
+Endpoints: POST /predict /generate /deploy /rollback; GET /healthz /metrics
+(?format=prometheus) /replicas /alerts /logs /trace. /generate (the decode
+plane's autoregressive endpoint) routes exactly like /predict: greedy decode
+is deterministic, so failover/breakers/canary cohorts apply unchanged.
 """
 from __future__ import annotations
 
@@ -129,6 +131,7 @@ class FleetFrontend(BackgroundHttpServer):
     def __init__(self, replicas, names=None, host="127.0.0.1", port=0,
                  health_interval_s=5.0, health_timeout_s=2.0,
                  predict_timeout_s=30.0, attempt_timeout_s=10.0,
+                 generate_timeout_s=300.0, generate_attempt_timeout_s=150.0,
                  breaker_failure_ratio=0.5, breaker_window=20,
                  breaker_min_calls=3, breaker_open_for_s=30.0,
                  alert_rules=None, alert_sinks=None, alert_interval_s=5.0,
@@ -164,6 +167,13 @@ class FleetFrontend(BackgroundHttpServer):
         self.health_timeout_s = float(health_timeout_s)
         self.predict_timeout_s = float(predict_timeout_s)
         self.attempt_timeout_s = float(attempt_timeout_s)
+        # /generate produces a whole token stream per request (queue wait +
+        # prefill + max_new_tokens steps), so it gets its own, much larger
+        # budgets: /predict-tuned 10s attempts would spuriously fail over a
+        # normal-length generation, feed the breaker's failure window with
+        # phantom faults, and burn BOTH replicas' slots on one request
+        self.generate_timeout_s = float(generate_timeout_s)
+        self.generate_attempt_timeout_s = float(generate_attempt_timeout_s)
         self._last_health_poll = None
         self._health_poll_lock = threading.Lock()
         self._route_lock = threading.Lock()
@@ -332,23 +342,33 @@ class FleetFrontend(BackgroundHttpServer):
                         ordered.append(r)
             return ordered
 
-    def _handle_predict(self, handler):
+    def _handle_predict(self, handler, path="/predict"):
+        """Route /predict — and /generate, which shares the whole contract:
+        greedy decode is deterministic, so a generate is as idempotent as a
+        predict and gets the same single-failover + breaker + canary-cohort
+        treatment (decode deploys are alert-gated exactly like /predict
+        ones)."""
         d = json.loads(handler.body())
-        with self.tracer.span("frontend_predict") as root:
+        with self.tracer.span("frontend_" + path.strip("/")) as root:
             t0 = monotonic_s()
-            status, payload = self._route_predict(d, root)
+            status, payload = self._route_predict(d, root, path=path)
             self.m_latency.observe((monotonic_s() - t0) * 1000.0)
             root.set_attribute("status", status)
         self.m_requests.inc(1, code=str(status))
         handler.send_json(status, payload, default=str)
 
-    def _route_predict(self, d, root):
-        """(status, payload) for one routed /predict under a total
+    def _route_predict(self, d, root, path="/predict"):
+        """(status, payload) for one routed idempotent POST under a total
         Deadline; at most MAX_ATTEMPTS real attempts on distinct replicas."""
+        generate = path == "/generate"
+        total_s = self.generate_timeout_s if generate \
+            else self.predict_timeout_s
+        attempt_s = self.generate_attempt_timeout_s if generate \
+            else self.attempt_timeout_s
         # the Deadline covers candidate selection too: a stale health cache
         # makes _pick_candidates sweep the replicas first, and that wait
         # must spend THIS request's budget, not stack on top of it
-        with Deadline(self.predict_timeout_s):
+        with Deadline(total_s):
             candidates = self._pick_candidates()
             if not candidates:
                 return 503, {"error": "no routable replica"}
@@ -366,8 +386,8 @@ class FleetFrontend(BackgroundHttpServer):
                                       attempt=attempts, retry=failover,
                                       cohort=cohort) as span:
                     try:
-                        res = post_json(replica.url + "/predict", d,
-                                        timeout=self.attempt_timeout_s)
+                        res = post_json(replica.url + path, d,
+                                        timeout=attempt_s)
                     except Exception as e:
                         last_exc = e
                         span.set_attribute("error", type(e).__name__)
@@ -571,6 +591,8 @@ class FleetFrontend(BackgroundHttpServer):
                 try:
                     if self.path == "/predict":
                         frontend._handle_predict(self)
+                    elif self.path == "/generate":
+                        frontend._handle_predict(self, path="/generate")
                     elif self.path == "/deploy":
                         frontend._handle_deploy(self)
                     elif self.path == "/rollback":

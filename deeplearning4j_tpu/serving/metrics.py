@@ -41,6 +41,9 @@ class ServingMetrics:
                                   "Requests failed in model dispatch")
         self.batch_size = reg.counter(
             "batch_size_total", "Dispatched batches by padded bucket size")
+        self.seq_bucket = reg.counter(
+            "seq_len_bucket_total",
+            "Sequence batches by padded power-of-two length bucket")
         self.latency = reg.histogram(
             "latency_ms", "Request latency, admission to completion (ms)")
         # pre-touch so a scrape before the first request still shows the
@@ -55,6 +58,9 @@ class ServingMetrics:
         self.requests.add(n_requests)
         self.rows.add(n_rows)
         self.batch_size.inc(1, bucket=str(bucket_rows))
+
+    def record_seq_bucket(self, len_bucket):
+        self.seq_bucket.inc(1, len_bucket=str(len_bucket))
 
     def record_latency(self, ms, trace_id=None):
         """`trace_id` becomes a bounded exemplar on the latency histogram —
@@ -88,6 +94,9 @@ class ServingMetrics:
             "batch_size_histogram": {str(k): v for k, v in
                                      sorted(batch_hist.items(),
                                             key=lambda kv: int(kv[0]))},
+            "seq_len_bucket_histogram": {
+                ls["len_bucket"]: v for ls, v in self.seq_bucket.series()
+                if "len_bucket" in ls},
             "version_rows": version_rows or {},
             "latency_ms": self.latency.percentiles(),
         }

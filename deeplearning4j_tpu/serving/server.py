@@ -5,6 +5,10 @@ Endpoints (all JSON unless noted, shared stdlib plumbing from util/http.py):
   POST /predict   {"data": nested list, "timeout_ms"?: N} or serde envelope
                   -> {"prediction", "shape", "version"}
                   429 + Retry-After when shed, 504 when the deadline expires
+  POST /generate  {"prompt": [ids], "max_new_tokens"?, "timeout_ms"?,
+                  "stop"?} -> {"tokens", "n_prompt", "version", "ttft_ms",
+                  "finish_reason"} — KV-cache continuous-batching decode
+                  (decode/; requires decode=True); same 429/504/503 contract
   GET  /models    -> {"models": [per-version info], "active": version}
   POST /deploy    {"version": v, "path"?: zip} -> load (if path) + warm-up +
                   atomic hot-swap; old version serves during warm-up
@@ -61,7 +65,10 @@ class ServingServer(BackgroundHttpServer):
                  session_id="serving", router_interval_s=10.0,
                  transform=None, tracer=None, scan_dir=None,
                  alert_rules=None, alert_sinks=None, alert_webhook=None,
-                 alert_interval_s=5.0, log_sinks=None):
+                 alert_interval_s=5.0, log_sinks=None,
+                 seq_len_bucketing=True, decode=False, decode_slots=4,
+                 decode_max_len=128, decode_queue_capacity=64,
+                 decode_max_new_tokens=32):
         # scan_dir: persistent registry directory — every ModelSerializer zip
         # in it is loaded at startup and POST /deploy accepts any model name
         # from it (see ModelRegistry.scan / deploy-by-name)
@@ -119,6 +126,23 @@ class ServingServer(BackgroundHttpServer):
                                   rules=rules, sinks=sinks,
                                   interval_s=alert_interval_s,
                                   logger=self.logger)
+        # padded+masked sequence-length buckets for 3-D (sequence) requests:
+        # requires the deployed models' output() to take a mask (every nn
+        # network type does); turn off for exotic duck-typed models
+        self.seq_len_bucketing = bool(seq_len_bucketing)
+        # autoregressive decode plane: POST /generate through a
+        # DecodeScheduler (KV-cache continuous batching; decode/)
+        self.decode = None
+        if decode:
+            from ..decode.scheduler import DecodeScheduler
+            self.decode = DecodeScheduler(
+                self.registry, self.metrics.registry,
+                slots=decode_slots, max_len=decode_max_len,
+                queue_capacity=decode_queue_capacity,
+                default_max_new_tokens=decode_max_new_tokens,
+                tracer=self.tracer, compile_tracker=self.compile_tracker,
+                logger=self.logger)
+            self.health.register("decode", self.decode.probe)
 
     # ---- health probes -----------------------------------------------------
     def _probe_admission(self):
@@ -183,7 +207,8 @@ class ServingServer(BackgroundHttpServer):
             # log2(max_batch_size)+1 bound and pollute the warm-up set, but
             # legacy clients may legitimately send any batch size
             return self._submit_chunked(x, deadline)
-        req = Request(x, deadline=deadline)
+        req = Request(x, deadline=deadline,
+                      seq_bucket=self.seq_len_bucketing)
         self.queue.offer(req)
         return req.future
 
@@ -218,7 +243,8 @@ class ServingServer(BackgroundHttpServer):
         return one future that concatenates the parts in order."""
         step = self.batcher.max_batch_size
         reqs = [Request(x[i:i + step], deadline=deadline,
-                        count_as_request=(i == 0))
+                        count_as_request=(i == 0),
+                        seq_bucket=self.seq_len_bucketing)
                 for i in range(0, x.shape[0], step)]
         agg = Future()
         remaining = [len(reqs)]
@@ -292,14 +318,26 @@ class ServingServer(BackgroundHttpServer):
         if loaded:
             self.registry.load(version, path)
         try:
-            return self.registry.deploy(version, warmup=self.batcher.warmup)
+            return self.registry.deploy(version, warmup=self._warmup)
         except Exception:
             if loaded:
                 self.registry.unregister(version)
             raise
 
+    def _warmup(self, model):
+        """Deploy-time warm-up: batcher buckets AND (when the decode plane
+        is on and the model streams) the decode executables, so neither
+        /predict nor /generate ever hits a cold hot-swapped version."""
+        self.batcher.warmup(model)
+        if self.decode is not None:
+            from ..decode.engine import DecodeUnsupported
+            try:
+                self.decode.warmup(model)
+            except DecodeUnsupported:
+                pass    # non-streaming model: /predict-only deploy is fine
+
     def rollback(self):
-        return self.registry.rollback(warmup=self.batcher.warmup)
+        return self.registry.rollback(warmup=self._warmup)
 
     # ---- lifecycle ---------------------------------------------------------
     def start(self):
@@ -323,6 +361,8 @@ class ServingServer(BackgroundHttpServer):
             self._final_flush_done = False
         self.batcher.start()
         self.alerts.start()
+        if self.decode is not None:
+            self.decode.start()
         server = self
 
         class Handler(QuietHandler):
@@ -382,6 +422,8 @@ class ServingServer(BackgroundHttpServer):
                 try:
                     if self.path == "/predict":
                         server._handle_predict(self)
+                    elif self.path == "/generate":
+                        server._handle_generate(self)
                     elif self.path == "/deploy":
                         d = json.loads(self.body() or b"{}")
                         prev = server.deploy(d["version"], path=d.get("path"))
@@ -406,6 +448,8 @@ class ServingServer(BackgroundHttpServer):
         """Graceful drain: stop admitting (new requests shed with 429),
         serve everything already queued, then stop the HTTP server."""
         self.alerts.stop()
+        if self.decode is not None:
+            self.decode.stop(drain=drain, timeout=timeout)
         self.queue.close()
         if not drain:
             self.queue.flush_expired_or_fail()
@@ -477,6 +521,66 @@ class ServingServer(BackgroundHttpServer):
                                 "shape": list(out.shape),
                                 "version": res["version"]})
 
+    def _handle_generate(self, handler):
+        """POST /generate {"prompt": [token ids], "max_new_tokens"?: N,
+        "timeout_ms"?: T, "stop"?: id} -> {"tokens", "n_prompt", "version",
+        "ttft_ms", "finish_reason"}. 404 when the decode plane is off,
+        429 when shed, 504 when the deadline passed before the first token,
+        503 with no model. A deadline hit MID-generation answers 200 with
+        the partial tokens and finish_reason="deadline" (the per-token
+        budget semantics)."""
+        if self.decode is None:
+            handler.send_json(
+                404, {"error": "decode plane disabled; start the server "
+                               "with decode=True"})
+            return
+        d = json.loads(handler.body() or b"{}")
+        prompt = d.get("prompt")
+        if not isinstance(prompt, list) or not prompt:
+            handler.send_json(400, {"error": "prompt must be a non-empty "
+                                             "list of token ids"})
+            return
+        timeout_ms = d.get("timeout_ms", self.default_timeout_ms)
+        with self.tracer.span("generate", n_prompt=len(prompt)) as root:
+            try:
+                fut = self.decode.submit(
+                    prompt, max_new_tokens=d.get("max_new_tokens"),
+                    timeout_ms=timeout_ms, stop_id=d.get("stop"))
+                wait_s = 120.0 if timeout_ms is None \
+                    else float(timeout_ms) / 1000.0 + 120.0
+                try:
+                    res = fut.result(timeout=wait_s)
+                except FuturesTimeoutError:
+                    # withdraw/clamp the request: an abandoned generation
+                    # must not keep burning a decode slot (mirror of the
+                    # /predict path's _abandon)
+                    self.decode.abandon(fut)
+                    raise
+            except DeadlineExceeded as e:
+                root.set_attribute("status", 504)
+                handler.send_json(504, {"error": str(e)})
+                return
+            except FuturesTimeoutError:
+                root.set_attribute("status", 503)
+                handler.send_json(503, {"error": "decode timed out"})
+                return
+            except NoModelDeployed as e:
+                root.set_attribute("status", 503)
+                handler.send_json(503, {"error": str(e)})
+                return
+            except ValueError as e:      # unservable request shape
+                root.set_attribute("status", 400)
+                handler.send_json(400, {"error": str(e)})
+                return
+            root.set_attribute("status", 200)
+            root.set_attribute("version", res["version"])
+            root.set_attribute("n_tokens", len(res["tokens"]))
+            self.logger.debug("generate_ok", n_prompt=len(prompt),
+                              n_tokens=len(res["tokens"]),
+                              finish_reason=res["finish_reason"],
+                              version=res["version"])
+        handler.send_json(200, res)
+
     def _healthz(self):
         """Deep health: aggregate of every registered component probe plus
         the legacy summary fields. `status` stays "ok" when everything is
@@ -493,10 +597,13 @@ class ServingServer(BackgroundHttpServer):
                 "active_version": self.registry.active_version}
 
     def _snapshot(self):
-        return self.metrics.snapshot(
+        snap = self.metrics.snapshot(
             queue_depth=self.queue.depth(),
             version_rows={v["version"]: v["serve_count"]
                           for v in self.registry.versions()})
+        if self.decode is not None:
+            snap["decode"] = self.decode.snapshot()
+        return snap
 
     def _metrics_snapshot(self):
         snap = self._snapshot()

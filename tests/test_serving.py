@@ -206,7 +206,7 @@ def test_zero_recompiles_mixed_sizes_within_bucket():
         # warm one bucket: sizes 3 and 4 both pad to bucket 4
         for rows in (3, 4):
             server.predict(rng.normal(size=(rows, 6)).astype(np.float32))
-        jitted = net._jit_cache[("output", False)]
+        jitted = net._jit_cache[("output", False, False)]
         assert jitted._cache_size() == 1      # ONE executable for the bucket
         for _ in range(20):                    # steady state: zero recompiles
             rows = int(rng.integers(3, 5))
@@ -531,7 +531,7 @@ def test_deploy_warmup_precompiles_observed_buckets():
         for rows in (3, 4, 2):
             server.predict(rng.normal(size=(rows, 6)).astype(np.float32))
         server.deploy("v2")                    # warms buckets {2, 4} on net2
-        jitted2 = net2._jit_cache[("output", False)]
+        jitted2 = net2._jit_cache[("output", False, False)]
         warmed = jitted2._cache_size()
         assert warmed == 2
         for _ in range(10):
@@ -999,3 +999,118 @@ def test_scan_dir_skips_unreadable_zip(tmp_path):
     assert "broken.zip" in registry.scan_errors
     registry.deploy("good")
     assert registry.active_version == "good"
+
+
+# ------------------------------------------- sequence-length bucketing
+
+def _lstm_net(vocab=12, hidden=8, seed=0):
+    from deeplearning4j_tpu.nn.conf.layers import GravesLSTM, RnnOutputLayer
+    conf = (NeuralNetConfiguration.builder()
+            .seed(seed).updater(Sgd(0.1)).list()
+            .layer(GravesLSTM(n_out=hidden, activation="tanh"))
+            .layer(RnnOutputLayer(n_out=vocab, activation="softmax",
+                                  loss="MCXENT"))
+            .input_type(InputType.recurrent(vocab))
+            .build())
+    return MultiLayerNetwork(conf).init()
+
+
+def _seq_x(vocab, *lengths, seed=0):
+    rng = np.random.default_rng(seed)
+    return [np.eye(vocab, dtype=np.float32)[
+        rng.integers(0, vocab, t)][None] for t in lengths]
+
+
+def test_seq_len_bucketing_coalesces_different_lengths():
+    """Requests of DIFFERENT sequence lengths share one padded+masked batch
+    and each caller's rows match the direct unpadded model.output — the
+    prefill-leg satellite's core contract."""
+    net = _lstm_net()
+    server = _component_server(net, max_latency_ms=100.0)
+    try:
+        xs = _seq_x(12, 3, 5, 4)
+        futs = [server.submit(x) for x in xs]     # one coalescing window
+        results = [f.result(timeout=60) for f in futs]
+        for x, res in zip(xs, results):
+            pred = np.asarray(res["prediction"])
+            assert pred.shape[1] == x.shape[1], "padding leaked to caller"
+            np.testing.assert_allclose(pred, np.asarray(net.output(x)),
+                                       rtol=1e-5, atol=1e-6)
+        # all three lengths (3, 5, 4) coalesced into ONE bucket-8 dispatch
+        assert server.metrics.batches.get() == 1
+        hist = {ls["len_bucket"]: v
+                for ls, v in server.metrics.seq_bucket.series() if ls}
+        assert hist == {"8": 1}
+        # the observed key carries the (batch, length) bucket pair
+        assert any(len(k) == 3 and k[2] == 8 for k in server.batcher.observed)
+    finally:
+        server.stop()
+
+
+def test_seq_len_bucketing_zero_steady_state_recompiles_and_warm_swap():
+    """Steady state over mixed lengths within one (batch, length) bucket
+    pair never recompiles, and a hot-swap warm-up replays the seq keys (so
+    the new version serves mixed lengths cold-free)."""
+    net = _lstm_net(seed=1)
+    server = _component_server(net, max_latency_ms=1.0)
+    try:
+        for t in (3, 5, 6, 2):
+            server.predict(_seq_x(12, t, seed=t)[0])
+        compiles = server.compile_tracker.total()
+        for t in (4, 7, 5, 3):                 # same bucket-8 executable
+            server.predict(_seq_x(12, t, seed=10 + t)[0])
+        assert server.compile_tracker.total() == compiles, \
+            "seq steady state recompiled"
+        # swap to a new version: warm-up replays the seq (bucket, length)
+        # keys with masks; serving after the swap stays recompile-free
+        net2 = _lstm_net(seed=2)
+        server.registry.register("v2", net2)
+        server.deploy("v2")
+        compiles = server.compile_tracker.total()
+        x = _seq_x(12, 5, seed=99)[0]
+        res = server.predict(x)
+        assert res["version"] == "v2"
+        np.testing.assert_allclose(np.asarray(res["prediction"]),
+                                   np.asarray(net2.output(x)),
+                                   rtol=1e-5, atol=1e-6)
+        assert server.compile_tracker.total() == compiles, \
+            "post-warm-up swap recompiled on a seq bucket"
+    finally:
+        server.stop()
+
+
+def test_seq_len_bucketing_opt_out_keeps_legacy_signatures():
+    net = _lstm_net(seed=3)
+    server = _component_server(net, seq_len_bucketing=False)
+    try:
+        x = _seq_x(12, 5, seed=5)[0]
+        res = server.predict(x)
+        np.testing.assert_allclose(np.asarray(res["prediction"]),
+                                   np.asarray(net.output(x)),
+                                   rtol=1e-6, atol=1e-7)
+        # legacy full-shape key: no length bucket dimension
+        assert all(len(k) == 2 for k in server.batcher.observed)
+    finally:
+        server.stop()
+
+
+def test_seq_requests_to_maskless_duck_typed_model_demote_to_legacy():
+    """A custom model whose output() takes no mask must keep serving 3-D
+    requests: the batcher demotes the seq batch to per-length legacy
+    dispatches instead of TypeErroring the whole batch."""
+    registry = ModelRegistry()
+    registry.register("v1", StubModel(2.0))
+    server = _component_server(None, registry=registry, max_latency_ms=100.0)
+    try:
+        registry.deploy("v1")
+        xs = _seq_x(12, 3, 5)
+        futs = [server.submit(x) for x in xs]
+        for x, f in zip(xs, futs):
+            res = f.result(timeout=60)
+            np.testing.assert_allclose(np.asarray(res["prediction"]),
+                                       x * 2.0, rtol=1e-6)
+        # demoted dispatches record LEGACY (2-tuple) keys, no seq keys
+        assert server.batcher.observed
+        assert all(len(k) == 2 for k in server.batcher.observed)
+    finally:
+        server.stop()

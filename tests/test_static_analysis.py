@@ -96,6 +96,17 @@ def fetch(url, tries):
         except OSError:
             time.sleep(attempt + 1.0)
 """, [8]),
+    "GL011": ("""\
+import jax.numpy as jnp
+
+def greedy_decode(model, params, ids, steps):
+    toks = jnp.asarray(ids)
+    for _ in range(steps):
+        logits = model(params, toks)
+        toks = jnp.concatenate([toks, logits[-1].argmax()[None]])
+        pad = jnp.zeros((len(toks),))
+    return toks
+""", [7, 8]),
 }
 
 
@@ -565,6 +576,72 @@ def wrap(step_fn):
                 rules=["GL010"]) == []
 
 
+def test_gl011_edges():
+    # one-shot setup concatenation (no loop) in a decode-named fn is quiet
+    setup = ("""\
+import jax.numpy as jnp
+
+def decode_setup(ids):
+    return jnp.concatenate([jnp.asarray(ids), jnp.zeros((2,))])
+""")
+    assert lint(setup, rules=["GL011"]) == []
+    # the same growing concat outside a decode-named function is quiet
+    other = ("""\
+import jax.numpy as jnp
+
+def train_loop(xs):
+    out = jnp.zeros((0,))
+    for x in xs:
+        out = jnp.concatenate([out, x])
+    return out
+""")
+    assert lint(other, rules=["GL011"]) == []
+    # python-list accumulation in a decode loop is the BLESSED host idiom
+    host = ("""\
+def generate(engine, cache, prompt, n):
+    out = []
+    for _ in range(n):
+        cache, nxt = engine.step(cache, out[-1] if out else prompt[-1])
+        out.append(int(nxt))
+    return out
+""")
+    assert lint(host, rules=["GL011"]) == []
+    # a loop inside a helper NESTED in a decode-named fn still counts
+    nested = ("""\
+import numpy as np
+
+def generate_stream(model, ids, n):
+    def run(toks):
+        for _ in range(n):
+            toks = np.concatenate([toks, model(toks)[-1:]])
+        return toks
+    return run(np.asarray(ids))
+""")
+    [v] = lint(nested, rules=["GL011"])
+    assert v.rule == "GL011" and v.line == 6
+    # len() sized shape ctor fires only inside the loop
+    lenout = ("""\
+import numpy as np
+
+def beam_decode(model, ids, n):
+    buf = np.zeros((len(ids) + n,))
+    for i in range(n):
+        buf[i] = model(buf)
+    return buf
+""")
+    assert lint(lenout, rules=["GL011"]) == []
+
+
+def test_gl011_repo_decode_paths_are_clean():
+    """Satellite gate: the decode subsystem itself (and everything else in
+    the package + tools) obeys its own rule — zero GL011 findings, zero
+    baselined remainders."""
+    report = Analyzer(rules=[get_rule("GL011")], root=str(REPO)).analyze_paths(
+        ["deeplearning4j_tpu", "tools"])
+    assert report.errors == []
+    assert report.violations == [], [str(v) for v in report.violations]
+
+
 def test_gl010_repo_hot_modules_donate_or_are_baselined():
     """Satellite gate: every params/opt_state jit in nn/ and parallel/
     donates its state args; the only remainders are the two inference
@@ -710,7 +787,7 @@ def test_cli_rule_subset_and_list_rules():
         assert rule.id in proc.stdout and rule.rationale
     assert [r.id for r in all_rules()] == \
         ["GL001", "GL002", "GL003", "GL004", "GL005", "GL006", "GL007",
-         "GL008", "GL009", "GL010"]
+         "GL008", "GL009", "GL010", "GL011"]
 
 
 def test_repo_gate_is_clean_and_fast():
