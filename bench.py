@@ -61,6 +61,7 @@ import json
 import os
 import subprocess
 import sys
+import tempfile
 import time
 
 import numpy as np
@@ -697,6 +698,62 @@ def bench_decode(slots=8, max_len=256, prompt_len=64, steps=48, vocab=256,
             "cache_mb": eng.cache_bytes() / 1e6}
 
 
+def bench_loadgen(rate=300.0, duration_s=2.0, n_replicas=3, seed=0):
+    """Elastic-fleet serving capacity, measured the loadgen way (ROADMAP
+    item 4): an OPEN-LOOP Poisson client (tools/loadgen.py — fixed offered
+    rate, no coordinated omission) drives a FleetFrontend at 1 replica and
+    then at `n_replicas`, same offered load. Reported: achieved rate and
+    p99 latency at both pool sizes — the scale claim as a measurement. The
+    N-replica numbers carry the release-over-release regression guard
+    (loadgen_achieved_rate / loadgen_p99_ms in the watched sets)."""
+    from tools.loadgen import predict_body, run_loadgen
+    from deeplearning4j_tpu.elastic import InProcessLauncher
+    from deeplearning4j_tpu.serving import FleetFrontend
+    from deeplearning4j_tpu.util.model_serializer import ModelSerializer
+    from deeplearning4j_tpu.zoo.models import mlp_mnist
+
+    net = mlp_mnist(hidden=256)
+    net.init()
+    body = predict_body(nin=784)
+    out = {}
+    with tempfile.TemporaryDirectory() as d:
+        ModelSerializer.write_model(net, os.path.join(d, "v1.zip"))
+        launcher = InProcessLauncher(
+            scan_dir=d, max_replicas=n_replicas,
+            server_opts=dict(max_batch_size=32, queue_capacity=64,
+                             alert_interval_s=0),
+            deploy_event={"kind": "deploy", "version": "v1"})
+        fe = None
+        try:
+            urls = [launcher.launch(f"b{i}") for i in range(n_replicas)]
+            fe = FleetFrontend(urls[:1], names=["b0"],
+                               health_interval_s=1e9,
+                               alert_interval_s=0).start()
+            run_loadgen(fe.url, body, rate=50.0, duration_s=0.5,
+                        seed=seed)                      # warm both paths
+            r1 = run_loadgen(fe.url, body, rate=rate,
+                             duration_s=duration_s, seed=seed)
+            for i in range(1, n_replicas):
+                fe.add_replica(urls[i], name=f"b{i}")
+            run_loadgen(fe.url, body, rate=50.0, duration_s=0.5,
+                        seed=seed)                      # warm new replicas
+            rn = run_loadgen(fe.url, body, rate=rate,
+                             duration_s=duration_s, seed=seed + 1)
+            out = {"offered_rate": rate, "replicas": n_replicas,
+                   "achieved_rate_1": r1["achieved_rate"],
+                   "p99_ms_1": r1["p99_ms"],
+                   "shed_ratio_1": r1["shed_ratio"],
+                   "achieved_rate_n": rn["achieved_rate"],
+                   "p99_ms_n": rn["p99_ms"],
+                   "shed_ratio_n": rn["shed_ratio"],
+                   "errors_5xx": r1["errors_5xx"] + rn["errors_5xx"]}
+        finally:
+            if fe is not None:
+                fe.stop()
+            launcher.close()
+    return out
+
+
 # metrics compared against the best prior BENCH_r*.json (higher is better);
 # >30% drops surface in the "regressions" list so relay weather and real
 # regressions are distinguishable at a glance (VERDICT r4 next #5)
@@ -704,11 +761,11 @@ WATCHED_METRICS = ("value", "lenet_samples_per_sec", "char_rnn_chars_per_sec",
                    "transformer_lm_tokens_per_sec", "word2vec_pairs_per_sec",
                    "flash_speedup", "e2e_samples_per_sec", "e2e_vs_compute",
                    "ucidigits_test_acc", "real32_test_acc",
-                   "decode_tokens_per_sec")
+                   "decode_tokens_per_sec", "loadgen_achieved_rate")
 # lower-is-better latency metrics: best prior = the MINIMUM, and a >50%
 # degradation (1.5x the best) lands in "regressions" (wider margin than the
 # throughput 30%: single-request latency is noisier on the shared relay)
-WATCHED_LOWER_METRICS = ("ttft_ms_p50", "decode_itl_ms")
+WATCHED_LOWER_METRICS = ("ttft_ms_p50", "decode_itl_ms", "loadgen_p99_ms")
 _RENAMED = {"mnist_real_test_acc": "ucidigits_test_acc"}
 
 
@@ -984,6 +1041,7 @@ def main():
                ("flash", lambda: bench_flash_attention()),
                ("decode", lambda: bench_decode()),
                ("word2vec", lambda: bench_word2vec()),
+               ("loadgen", lambda: bench_loadgen()),
                ("scaling", lambda: bench_scaling_subprocess())]
     if headline_is_resnet:
         # e2e ratio only makes sense against a ResNet-50 compute headline,
@@ -1052,6 +1110,26 @@ def main():
                 extras["decode_cache_mb"] = round(r["cache_mb"], 1)
             elif name == "word2vec":
                 extras["word2vec_pairs_per_sec"] = round(r, 1)
+            elif name == "loadgen":
+                # serving capacity at 1 vs N replicas under the SAME
+                # open-loop offered rate; the N-replica numbers are the
+                # guarded ones (watched sets)
+                extras["loadgen_offered_rate"] = round(r["offered_rate"], 1)
+                extras["loadgen_replicas"] = r["replicas"]
+                extras["loadgen_achieved_rate_1"] = round(
+                    r["achieved_rate_1"], 1)
+                extras["loadgen_p99_ms_1"] = round(r["p99_ms_1"], 2)
+                extras["loadgen_shed_ratio_1"] = round(r["shed_ratio_1"], 3)
+                extras["loadgen_achieved_rate"] = round(
+                    r["achieved_rate_n"], 1)
+                extras["loadgen_p99_ms"] = round(r["p99_ms_n"], 2)
+                extras["loadgen_shed_ratio"] = round(r["shed_ratio_n"], 3)
+                extras["loadgen_errors_5xx"] = r["errors_5xx"]
+                extras["loadgen_note"] = (
+                    "in-process replicas share ONE host CPU (like "
+                    "spmd_strong_ratio): achieved-vs-offered and p99 are "
+                    "the guarded capacity numbers, not a linear-scaling "
+                    "claim")
             else:
                 extras["spmd_strong_ratio"] = round(r["strong_ratio"], 2)
                 extras["spmd_strong_note"] = (

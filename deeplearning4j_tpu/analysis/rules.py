@@ -869,3 +869,97 @@ class DecodeDynamicShapeRule(Rule):
                         and sub.func.id == "len":
                     return True
         return False
+
+
+# ---------------------------------------------------------------------------
+# GL012 — unbounded-spawn
+# ---------------------------------------------------------------------------
+
+@register
+class UnboundedSpawnRule(Rule):
+    """Thread/process spawn inside a while loop without a max-count guard."""
+
+    id = "GL012"
+    name = "unbounded-spawn"
+    rationale = (
+        "The elastic subsystem makes replica/thread spawning a routine "
+        "reaction to load signals — and a reaction loop with no ceiling is "
+        "how a flapping signal (or a health probe that never goes green) "
+        "forks servers until the host dies. Spawn authority therefore "
+        "lives behind the ReplicaLauncher SPI (elastic/launcher.py), which "
+        "enforces max_replicas at the one choke point. Everywhere else, a "
+        "threading.Thread/subprocess.Popen constructed inside a `while` "
+        "loop — the unbounded-iteration shape — must sit in a function "
+        "that visibly bounds the count (a comparison against a "
+        "max/cap/limit/capacity name, or a non-blocking Semaphore "
+        "acquire). For-loop spawns over a materialized collection "
+        "(_fan_out, pipeline worker pools) are bounded by construction "
+        "and stay quiet.")
+
+    SPAWN_CALLS = frozenset({"threading.Thread", "subprocess.Popen",
+                             "multiprocessing.Process"})
+    #: the launcher/controller modules that OWN spawn (and its guard)
+    ALLOWED_FILES = ("deeplearning4j_tpu/elastic/launcher.py",)
+    GUARD_RE = re.compile(r"max|cap(?:acity)?|limit|budget|bound",
+                          re.IGNORECASE)
+
+    def check(self, ctx):
+        if ctx.rel_path in self.ALLOWED_FILES:
+            return
+        aliases = ctx.aliases
+        for node in ctx.nodes:
+            if not isinstance(node, ast.Call):
+                continue
+            if qualname(node.func, aliases) not in self.SPAWN_CALLS:
+                continue
+            fn = self._enclosing_while_fn(ctx, node)
+            if fn is None:
+                continue
+            if self._has_count_guard(fn):
+                continue
+            yield self.violation(
+                ctx, node,
+                "thread/process spawn inside a while loop with no visible "
+                "max-count guard: a wedged condition forks until the host "
+                "dies; bound it (compare against a max_*/cap/limit, or a "
+                "non-blocking Semaphore.acquire) or route the spawn "
+                "through the elastic ReplicaLauncher SPI")
+
+    @staticmethod
+    def _enclosing_while_fn(ctx, node):
+        """The enclosing function def IF the spawn sits inside a `while`
+        loop within it (the innermost def wins: a bounded helper defined
+        inside an unbounded loop is judged on its own body)."""
+        in_while = False
+        for anc in ctx.ancestors(node):
+            if isinstance(anc, ast.While):
+                in_while = True
+            elif isinstance(anc, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                return anc if in_while else None
+        return None
+
+    @classmethod
+    def _has_count_guard(cls, fn):
+        """A visible bound anywhere in the enclosing function: a comparison
+        touching a max/cap/limit-named name or attribute, or a
+        `sem.acquire(blocking=False)` try-acquire."""
+        for node in ast.walk(fn):
+            if isinstance(node, ast.Compare):
+                for side in [node.left] + list(node.comparators):
+                    for sub in ast.walk(side):
+                        name = None
+                        if isinstance(sub, ast.Name):
+                            name = sub.id
+                        elif isinstance(sub, ast.Attribute):
+                            name = sub.attr
+                        if name is not None and cls.GUARD_RE.search(name):
+                            return True
+            if isinstance(node, ast.Call) \
+                    and isinstance(node.func, ast.Attribute) \
+                    and node.func.attr == "acquire":
+                for kw in node.keywords:
+                    if kw.arg == "blocking" \
+                            and isinstance(kw.value, ast.Constant) \
+                            and kw.value.value is False:
+                        return True
+        return False

@@ -1,0 +1,432 @@
+"""Serving autoscaler: FleetFrontend signals -> declarative policy ->
+spawn/drain replicas through a pluggable ReplicaLauncher.
+
+The observe half exists (replica deep-healthz, queue depth, shed/request
+counters, breaker states, all on /fleet/*); this is the react half for
+capacity. An `AutoscaleController` periodically (or on demand — every
+timestamp rides util/time_source, so ManualClock tests drive whole
+scale-up/preempt/drain arcs with zero real sleeps):
+
+1. sweeps the frontend pool (forced deep-health poll) and each routable
+   replica's /metrics snapshot, publishing the aggregate as instruments in
+   the frontend's own MetricsRegistry: `autoscale_queue_depth`,
+   `autoscale_breakers_open`, `autoscale_replicas_down` gauges and
+   mirrored `autoscale_requests_total` / `autoscale_shed_total` counters
+   (summed positive deltas across replicas) — so every scaling input is
+   scrapeable on /metrics and /fleet/metrics;
+2. evaluates the policy through the stock AlertEngine machinery: each
+   per-signal threshold compiles to an AlertRule (`for_duration_s` = the
+   same flap damping alerts use, `shed_ratio` = the same windowed
+   counter-delta ratio), so scale decisions inherit the
+   pending->firing lifecycle instead of reacting to one noisy sample;
+3. acts: ANY firing scale-up rule grows the pool by `step` (bounded by
+   `max_replicas`), ALL scale-down rules firing together shrinks it
+   (bounded by `min_replicas`), a replica reported down/unroutable past
+   `down_grace_s` is removed and replaced — each under `cooldown_s` so the
+   controller cannot flap, and each emitted exactly once to the alert
+   sinks, the structured log (trace-correlated: every action runs inside
+   an `autoscale` span), and `autoscale_transitions_total{action}`.
+
+Spawn/drain goes through the `ReplicaLauncher` SPI (launcher.py): the
+launcher owns the process/thread and the max-replica guard (graftlint
+GL012), the controller owns the decision; new replicas come up warm before
+they join the pool (the launcher replays the newest deploy event through
+the RegistrySubscriber path and fans subsequent deploys over the broker).
+
+Policy JSON shape (round-trips via AutoscalePolicy.to_dict/from_dict):
+
+    {"min_replicas": 1, "max_replicas": 3, "step": 1,
+     "cooldown_s": 60.0, "for_duration_s": 0.0, "window_s": 60.0,
+     "down_grace_s": 0.0,
+     "scale_up":   {"queue_depth": 8, "shed_ratio": 0.05,
+                    "breakers_open": 1, "replicas_down": 1},
+     "scale_down": {"queue_depth": 1}}
+"""
+from __future__ import annotations
+
+import threading
+from collections import deque
+
+from ..telemetry.alerts import AlertEngine, AlertRule, FIRING
+from ..util.http import get_json
+from ..util.time_source import monotonic_s, now_s
+
+#: signal name -> (instrument kind, op for scale-up). Threshold signals
+#: compare the gauge instantaneously; "shed_ratio" is the windowed
+#: counter-delta ratio over the mirrored counters.
+_UP_SIGNALS = {"queue_depth": ">", "breakers_open": ">=",
+               "replicas_down": ">=", "shed_ratio": ">"}
+_DOWN_SIGNALS = {"queue_depth": "<=", "shed_ratio": "<="}
+
+
+class AutoscalePolicy:
+    """Declarative scaling policy; see module docstring for the JSON."""
+
+    def __init__(self, min_replicas=1, max_replicas=3, step=1,
+                 cooldown_s=60.0, for_duration_s=0.0, window_s=60.0,
+                 down_grace_s=0.0, scale_up=None, scale_down=None):
+        self.min_replicas = int(min_replicas)
+        self.max_replicas = int(max_replicas)
+        if not 1 <= self.min_replicas <= self.max_replicas:
+            raise ValueError("need 1 <= min_replicas <= max_replicas")
+        self.step = int(step)
+        self.cooldown_s = float(cooldown_s)
+        self.for_duration_s = float(for_duration_s)
+        self.window_s = float(window_s)
+        self.down_grace_s = float(down_grace_s)
+        self.scale_up = dict(scale_up if scale_up is not None
+                             else {"queue_depth": 8.0, "shed_ratio": 0.05})
+        self.scale_down = dict(scale_down if scale_down is not None
+                               else {"queue_depth": 1.0})
+        for sig in self.scale_up:
+            if sig not in _UP_SIGNALS:
+                raise ValueError(f"unknown scale_up signal {sig!r} "
+                                 f"(one of {sorted(_UP_SIGNALS)})")
+        for sig in self.scale_down:
+            if sig not in _DOWN_SIGNALS:
+                raise ValueError(f"unknown scale_down signal {sig!r} "
+                                 f"(one of {sorted(_DOWN_SIGNALS)})")
+
+    def to_dict(self):
+        return {"min_replicas": self.min_replicas,
+                "max_replicas": self.max_replicas, "step": self.step,
+                "cooldown_s": self.cooldown_s,
+                "for_duration_s": self.for_duration_s,
+                "window_s": self.window_s,
+                "down_grace_s": self.down_grace_s,
+                "scale_up": dict(self.scale_up),
+                "scale_down": dict(self.scale_down)}
+
+    @classmethod
+    def from_dict(cls, d):
+        return cls(**dict(d))
+
+    # ---- AlertEngine compilation ------------------------------------------
+    def _rule(self, prefix, signal, op, threshold):
+        name = f"autoscale_{prefix}_{signal}"
+        if signal == "shed_ratio":
+            return AlertRule(name, "ratio",
+                             numerator="autoscale_shed_total",
+                             denominator=["autoscale_requests_total",
+                                          "autoscale_shed_total"],
+                             op=op, threshold=threshold,
+                             window_s=self.window_s,
+                             for_duration_s=self.for_duration_s,
+                             severity="info",
+                             description=f"autoscale {prefix} signal")
+        return AlertRule(name, "threshold", metric=f"autoscale_{signal}",
+                         op=op, threshold=threshold,
+                         for_duration_s=self.for_duration_s,
+                         severity="info",
+                         description=f"autoscale {prefix} signal")
+
+    def rules(self):
+        """(up_rules, down_rules) compiled for an AlertEngine."""
+        up = [self._rule("up", sig, _UP_SIGNALS[sig], thr)
+              for sig, thr in sorted(self.scale_up.items())]
+        down = [self._rule("down", sig, _DOWN_SIGNALS[sig], thr)
+                for sig, thr in sorted(self.scale_down.items())]
+        return up, down
+
+
+class AutoscaleController:
+    """See module docstring. `frontend` is a serving.FleetFrontend whose
+    pool this controller owns; `launcher` a ReplicaLauncher; `policy` an
+    AutoscalePolicy (or its JSON dict). `sinks` receive one event dict per
+    transition (the alert-sink calling convention); `interval_s > 0` runs
+    `evaluate()` on a background thread, 0 leaves it caller-driven."""
+
+    def __init__(self, frontend, launcher, policy, sinks=None,
+                 interval_s=0.0, metrics_timeout_s=2.0):
+        self.frontend = frontend
+        self.launcher = launcher
+        self.policy = policy if isinstance(policy, AutoscalePolicy) \
+            else AutoscalePolicy.from_dict(policy)
+        self.sinks = list(sinks or [])
+        self.interval_s = float(interval_s)
+        self.metrics_timeout_s = float(metrics_timeout_s)
+        self.registry = frontend.registry
+        self.logger = frontend.logger
+        self.tracer = frontend.tracer
+        # bounded action history, NEWEST kept: the operator-facing
+        # status() view must show what just happened, not event #1000
+        self.transitions = deque(maxlen=1000)
+        self.evaluations = 0
+        self._last_action = None           # monotonic_s of last scale action
+        self._last_totals = {}             # replica -> (requests, shed)
+        self._down_since = {}              # replica -> monotonic_s first down
+        self._seq = 0                      # launched-replica name counter
+        self._lock = threading.Lock()
+        self._stop = threading.Event()
+        self._thread = None
+
+        self._g_queue = self.registry.gauge(
+            "autoscale_queue_depth",
+            "Summed admitted-undispatched depth across routable replicas")
+        self._g_breakers = self.registry.gauge(
+            "autoscale_breakers_open", "Replica circuit breakers open")
+        self._g_down = self.registry.gauge(
+            "autoscale_replicas_down",
+            "Pool replicas reported down/unroutable")
+        self._g_size = self.registry.gauge(
+            "autoscale_replicas", "Current serving pool size")
+        self._m_requests = self.registry.counter(
+            "autoscale_requests_total",
+            "Requests answered across the pool (mirrored replica deltas)")
+        self._m_shed = self.registry.counter(
+            "autoscale_shed_total",
+            "Requests shed (429) across the pool (mirrored replica deltas)")
+        self._m_transitions = self.registry.counter(
+            "autoscale_transitions_total", "Scaling actions, by action")
+        for action in ("scale_up", "scale_down", "replace_dead",
+                       "ensure_min"):
+            self._m_transitions.inc(0, action=action)
+        self._m_requests.inc(0)
+        self._m_shed.inc(0)
+        self._g_size.set(float(len(frontend.replicas)))
+
+        up, down = self.policy.rules()
+        self._up_names = [r.name for r in up]
+        self._down_names = [r.name for r in down]
+        # interval_s=0: the controller's evaluate() drives this engine, so
+        # the engine's own background loop stays off either way
+        self.alerts = AlertEngine(registry=self.registry, rules=up + down,
+                                  interval_s=0, logger=self.logger)
+
+    # ---- signal collection -------------------------------------------------
+    def collect_signals(self):
+        """Sweep the pool and publish the scaling inputs as instruments.
+        Down replicas cost one bounded timeout each (the frontend's health
+        sweep is already concurrent); a replica that answers /healthz but
+        not /metrics just contributes no counter delta this tick."""
+        fe = self.frontend
+        fe.poll_health(force=True)
+        replicas = list(fe.replicas)
+        queue_depth, requests, shed = 0.0, 0.0, 0.0
+        down = []
+        for r in replicas:
+            if not r.routable():
+                down.append(r.name)
+                continue
+            try:
+                snap = get_json(r.url + "/metrics",
+                                timeout=self.metrics_timeout_s)
+            except Exception:
+                # a routable (health-passing) replica whose /metrics scrape
+                # failed is NOT down — it just contributes no counter delta
+                # this tick. Marking it down here would let one slow scrape
+                # under load hard-terminate a healthy replica.
+                continue
+            if not isinstance(snap, dict):
+                continue
+            queue_depth += float(snap.get("queue_depth") or 0.0)
+            prev_req, prev_shed = self._last_totals.get(r.name, (None, None))
+            cur_req = float(snap.get("requests") or 0.0)
+            cur_shed = float(snap.get("shed") or 0.0)
+            # mirror positive deltas only: a restarted/replaced replica's
+            # counter reset must not subtract from the pool totals
+            if prev_req is not None and cur_req > prev_req:
+                requests += cur_req - prev_req
+            if prev_shed is not None and cur_shed > prev_shed:
+                shed += cur_shed - prev_shed
+            self._last_totals[r.name] = (cur_req, cur_shed)
+        open_breakers = sum(1 for r in replicas
+                            if r.breaker.state_code >= 2)
+        self._g_queue.set(queue_depth)
+        self._g_breakers.set(float(open_breakers))
+        self._g_down.set(float(len(down)))
+        self._g_size.set(float(len(replicas)))
+        if requests:
+            self._m_requests.inc(requests)
+        if shed:
+            self._m_shed.inc(shed)
+        now = monotonic_s()
+        for name in list(self._down_since):
+            if name not in down:
+                self._down_since.pop(name, None)
+        for name in down:
+            self._down_since.setdefault(name, now)
+        return {"queue_depth": queue_depth, "down": down,
+                "breakers_open": open_breakers, "replicas": len(replicas)}
+
+    # ---- decision + action -------------------------------------------------
+    def _cooldown_ok(self):
+        return self._last_action is None or \
+            monotonic_s() - self._last_action >= self.policy.cooldown_s
+
+    def _transition(self, action, **fields):
+        """One scaling action, emitted exactly once everywhere the canary
+        transitions go: counter, trace-correlated structured log, sinks,
+        bounded history."""
+        self._m_transitions.inc(1, action=action)
+        self._last_action = monotonic_s()
+        event = {"type": "autoscale", "action": action, "time": now_s(),
+                 "pool_size": len(self.frontend.replicas), **fields}
+        self.logger.info(f"autoscale_{action}", **{k: v for k, v in
+                                                   event.items()
+                                                   if k not in ("type",)})
+        self.transitions.append(event)
+        for sink in self.sinks:
+            try:
+                sink(event)
+            except Exception:
+                self.logger.warning("autoscale_sink_error",
+                                    sink=type(sink).__name__, action=action)
+        return event
+
+    def _spawn(self, reason):
+        self._seq += 1
+        name = f"as{self._seq}"
+        url = self.launcher.launch(name)
+        self.frontend.add_replica(url, name=name)
+        return name, url
+
+    def _scale_up(self, firing):
+        added = []
+        for _ in range(self.policy.step):
+            if len(self.frontend.replicas) >= self.policy.max_replicas:
+                break
+            name, url = self._spawn("scale_up")
+            added.append({"replica": name, "url": url})
+        if added:
+            self._transition("scale_up", added=added,
+                             signals=sorted(firing))
+        return added
+
+    def _scale_down(self, firing):
+        removed = []
+        pool = list(self.frontend.replicas)
+        # newest launched replicas drain first; never touch the last one
+        launched = [r.name for r in pool if r.name in self.launcher.names()]
+        victims = list(reversed(launched))[:self.policy.step]
+        for name in victims:
+            if len(self.frontend.replicas) <= self.policy.min_replicas:
+                break
+            self.frontend.remove_replica(name)   # no new traffic from here
+            self.launcher.drain(name)            # graceful: finish + stop
+            self._last_totals.pop(name, None)
+            removed.append(name)
+        if removed:
+            self._transition("scale_down", removed=removed,
+                             signals=sorted(firing))
+        return removed
+
+    def _replace_dead(self, signals):
+        """Remove replicas down past `down_grace_s` and spawn replacements
+        up to the policy minimum — the preemption-healing path."""
+        now = monotonic_s()
+        dead = [n for n in signals["down"]
+                if now - self._down_since.get(n, now)
+                >= self.policy.down_grace_s]
+        acted = False
+        for name in dead:
+            # free the launcher slot FIRST: the replica is dead at the HTTP
+            # level, so terminating its launcher record is safe, and a
+            # launcher at max_replicas must be able to spawn the
+            # replacement below (dead slot freed before the spawn)
+            self.launcher.terminate(name)
+            replacement = None
+            if len(self.frontend.replicas) - 1 < self.policy.min_replicas:
+                # spawn the replacement BEFORE removing from the pool: the
+                # pool may never go empty, and a sole dead replica must
+                # still be healable
+                try:
+                    rname, url = self._spawn("replace_dead")
+                    replacement = {"replica": rname, "url": url}
+                except Exception as e:
+                    self.logger.error("autoscale_replace_spawn_failed",
+                                      dead=name,
+                                      error=f"{type(e).__name__}: {e}")
+                    # keep the handle: it stays in `down`, so the next tick
+                    # retries the whole heal (the slot is free now)
+                    continue
+            try:
+                self.frontend.remove_replica(name)
+            except (KeyError, ValueError):
+                continue
+            self._last_totals.pop(name, None)
+            self._down_since.pop(name, None)
+            self._transition("replace_dead", removed=name,
+                             replacement=replacement)
+            acted = True
+        return acted
+
+    def _ensure_min(self):
+        """Restore the policy minimum (spawn failures in earlier ticks can
+        leave the pool short): top up to min_replicas, not cooldown-gated —
+        the minimum is an invariant, not a scaling decision."""
+        added = []
+        while len(self.frontend.replicas) < self.policy.min_replicas:
+            try:
+                name, url = self._spawn("ensure_min")
+            except Exception as e:
+                self.logger.error("autoscale_ensure_min_failed",
+                                  error=f"{type(e).__name__}: {e}")
+                break
+            added.append({"replica": name, "url": url})
+        if added:
+            self._transition("ensure_min", added=added)
+        return bool(added)
+
+    def evaluate(self):
+        """One full tick: collect -> alert-evaluate -> act (cooldown- and
+        bound-gated). Returns a summary dict (assertable in tests/smoke)."""
+        with self._lock:
+            self.evaluations += 1
+            with self.tracer.span("autoscale", tick=self.evaluations):
+                signals = self.collect_signals()
+                self.alerts.evaluate()
+                states = {r.name: r.state for r in self.alerts.rules}
+                up_firing = [n for n in self._up_names
+                             if states.get(n) == FIRING]
+                down_firing = [n for n in self._down_names
+                               if states.get(n) == FIRING]
+                action = None
+                if self._replace_dead(signals):
+                    action = "replace_dead"
+                elif self._ensure_min():
+                    action = "ensure_min"
+                elif up_firing and self._cooldown_ok() and \
+                        len(self.frontend.replicas) < self.policy.max_replicas:
+                    if self._scale_up(up_firing):
+                        action = "scale_up"
+                elif (down_firing
+                      and len(down_firing) == len(self._down_names)
+                      and not up_firing and self._cooldown_ok()
+                      and len(self.frontend.replicas)
+                      > self.policy.min_replicas):
+                    if self._scale_down(down_firing):
+                        action = "scale_down"
+                return {"action": action, "signals": signals,
+                        "up_firing": up_firing, "down_firing": down_firing,
+                        "pool": [r.name for r in self.frontend.replicas]}
+
+    def status(self):
+        return {"policy": self.policy.to_dict(),
+                "evaluations": self.evaluations,
+                "pool": [r.to_dict() for r in self.frontend.replicas],
+                "transitions": list(self.transitions)[-50:]}
+
+    # ---- background loop ---------------------------------------------------
+    def start(self):
+        if self.interval_s <= 0 or \
+                (self._thread is not None and self._thread.is_alive()):
+            return self
+        self._stop.clear()
+        self._thread = threading.Thread(target=self._run, daemon=True,
+                                        name="autoscale-controller")
+        self._thread.start()
+        return self
+
+    def _run(self):
+        while not self._stop.wait(self.interval_s):
+            try:
+                self.evaluate()
+            except Exception:
+                self.logger.error("autoscale_evaluate_error")
+
+    def stop(self):
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(5.0)
+            self._thread = None

@@ -1,0 +1,110 @@
+"""Replica membership: the heartbeat view elastic policies decide on.
+
+A `MembershipView` tracks a fixed universe of named members (training
+workers = mesh data-axis slots, or serving replicas), each with a last-
+heartbeat timestamp on the injected clock (util/time_source — a ManualClock
+test drives staleness with zero sleeps). A member is *alive* when it has
+beaten within `ttl_s` and has not been explicitly killed; `kill`/`revive`
+are the explicit preemption signals (chaos `preempt` rules, a cloud
+preemption notice, an operator drain), while the ttl catches the silent
+death nobody announced.
+
+`version` increments on every *explicit* aliveness change (join / kill /
+revive / leave) — useful for change feeds and status views. Note it can
+NOT see ttl staleness (a member going silent changes `alive()` with no
+version bump), so policy consumers (ElasticTrainer) diff the alive set
+itself rather than gating on the counter.
+"""
+from __future__ import annotations
+
+import threading
+
+from ..util.time_source import monotonic_s
+
+
+class MembershipView:
+    """Heartbeat-tracked member set; see module docstring."""
+
+    def __init__(self, members=(), ttl_s=30.0):
+        self.ttl_s = float(ttl_s)
+        self._lock = threading.Lock()
+        self._beats = {}          # guarded by: self._lock — name -> last beat
+        self._killed = set()      # guarded by: self._lock
+        self.version = 0
+        for m in members:
+            self.join(m)
+
+    def _bump(self):
+        self.version += 1
+
+    def join(self, name):
+        """Register (or re-register) a member as alive now."""
+        name = str(name)
+        with self._lock:
+            self._beats[name] = monotonic_s()
+            self._killed.discard(name)
+            self._bump()
+        return name
+
+    def heartbeat(self, name):
+        """Record a liveness beat. A killed member's stray beat is ignored:
+        the explicit preemption signal outranks a straggler thread."""
+        with self._lock:
+            if name in self._beats and name not in self._killed:
+                self._beats[name] = monotonic_s()
+
+    def kill(self, name):
+        """Explicitly mark `name` dead (preemption notice / chaos rule).
+        Returns True when this changed its aliveness."""
+        with self._lock:
+            if name not in self._beats or name in self._killed:
+                return False
+            self._killed.add(name)
+            self._bump()
+            return True
+
+    def revive(self, name):
+        """Bring a killed/stale member back (fresh heartbeat)."""
+        with self._lock:
+            if name not in self._beats:
+                raise KeyError(f"unknown member {name!r}")
+            changed = name in self._killed \
+                or not self._fresh_beat(self._beats[name])
+            self._killed.discard(name)
+            self._beats[name] = monotonic_s()
+            if changed:
+                self._bump()
+            return changed
+
+    def leave(self, name):
+        """Remove `name` from the universe entirely."""
+        with self._lock:
+            if self._beats.pop(name, None) is not None:
+                self._killed.discard(name)
+                self._bump()
+
+    def _fresh_beat(self, beat):
+        return monotonic_s() - beat <= self.ttl_s
+
+    def alive(self):
+        """Sorted list of alive member names (fresh beat, not killed)."""
+        with self._lock:
+            return sorted(n for n, b in self._beats.items()
+                          if n not in self._killed and self._fresh_beat(b))
+
+    def members(self):
+        with self._lock:
+            return sorted(self._beats)
+
+    def status(self):
+        """JSON view for /fleet-style surfaces: per-member aliveness plus
+        the change version."""
+        with self._lock:
+            now = monotonic_s()
+            return {"version": self.version, "ttl_s": self.ttl_s,
+                    "members": {
+                        n: {"alive": (n not in self._killed
+                                      and now - b <= self.ttl_s),
+                            "killed": n in self._killed,
+                            "age_s": now - b}
+                        for n, b in sorted(self._beats.items())}}

@@ -1,0 +1,198 @@
+"""Elastic training: replica loss/gain re-shards ZeRO state, training
+continues with momentum intact — no checkpoint-and-halt.
+
+`FaultTolerantTrainer` (train/fault_tolerance.py) survives preemption by
+dying and resuming from the last checkpoint; production scale wants the
+complementary policy: when the *topology* changes under a live run (a
+replica is preempted, or a preempted one comes back), keep the process and
+re-shape the run. The rails are already in-tree — PR 9 made optimizer
+state topology-independent (`ZeroUpdater.to_canonical/from_canonical`, the
+arXiv 2004.13336 layout) and `ShardedTrainer(shard_update=True)` installs
+it on any mesh — so a re-shard is: take the live network (params replicated,
+moments sharded flat over the old data axis), build a `ShardedTrainer` over
+the surviving devices, and let `set_update_sharding` convert the moments
+old-sharded -> canonical -> new-sharded. Bit-for-bit, momentum included
+(tests/test_zero.py asserts parity through shrink/grow/repeat re-shards).
+
+`ElasticTrainer` is that policy as a `FaultTolerantTrainer` subclass: the
+checkpoint/resume machinery is unchanged (a run can still be killed
+outright and resume at the CURRENT replica count — `adopt` re-shards the
+canonical checkpoint), but on a membership change the trainer re-shards
+in-process between two batches instead of halting. Membership comes from a
+heartbeat `MembershipView` (or any injected view) and/or a chaos
+`FaultPlan` with `preempt` rules polled once per step, so the acceptance
+scenario — a FaultPlan kills a replica mid-run, training finishes converged
+with final-param parity vs an uninterrupted run — is scriptable in the same
+JSON plan format as every other fault.
+
+Every transition is observable: an `elastic_reshard` span, structured logs
+with trace correlation, `elastic_reshards_total{direction}` /
+`elastic_preemptions_total` counters and the `elastic_replicas` gauge in
+the process registry (so /fleet/metrics scrapes see them), and the
+trainer's health probe carries the membership view.
+"""
+from __future__ import annotations
+
+from ..telemetry.registry import get_registry
+from ..telemetry.trace import get_tracer
+from ..train.fault_tolerance import CheckpointConfig, FaultTolerantTrainer
+from ..util.time_source import monotonic_s
+from .membership import MembershipView
+
+
+class ElasticImpossible(RuntimeError):
+    """Membership fell below `min_replicas`: elasticity cannot absorb this
+    (the final checkpoint was written before raising)."""
+
+
+class ElasticTrainer(FaultTolerantTrainer):
+    """See module docstring. `net_factory` builds the plain network; the
+    trainer wraps it in a ZeRO `ShardedTrainer` over the alive members'
+    devices and rebuilds that wrapper on every membership change.
+
+    `devices`: the device universe, one data-axis slot per member (default:
+    all of jax.devices()). `membership`: an external MembershipView (the
+    trainer then only *reads* aliveness — some other system beats); omitted,
+    the trainer owns one member per device (named w0..wN-1) and beats the
+    un-killed ones itself each step. `plan`: a resilience.FaultPlan whose
+    `preempt` rules are polled once per step and applied to the view.
+    """
+
+    def __init__(self, net_factory, checkpoint: CheckpointConfig,
+                 devices=None, membership=None, plan=None, rules=None,
+                 min_replicas=1, health=None, monitor=None, logger=None):
+        import jax
+        devices = list(devices) if devices is not None else list(jax.devices())
+        if not devices:
+            raise ValueError("elastic training needs at least one device")
+        self._net_factory = (net_factory if callable(net_factory)
+                             else (lambda: net_factory))
+        self._device_of = {f"w{i}": d for i, d in enumerate(devices)}
+        self._owns_view = membership is None
+        self.membership = membership if membership is not None else \
+            MembershipView(sorted(self._device_of))
+        self.plan = plan
+        self.rules = rules
+        self.min_replicas = int(min_replicas)
+        self.reshards = 0
+        self.preemption_events = []          # applied kill/revive events
+        if logger is None:
+            from ..telemetry.logging import get_logger
+            logger = get_logger()
+        self.logger = logger
+        self._alive = self._alive_members()
+        if len(self._alive) < self.min_replicas:
+            raise ValueError(f"only {len(self._alive)} alive members for "
+                             f"min_replicas={self.min_replicas}")
+        reg = get_registry()
+        self._m_reshards = reg.counter(
+            "elastic_reshards_total",
+            "In-process ZeRO re-shards on membership change, by direction")
+        self._m_preempt = reg.counter(
+            "elastic_preemptions_total",
+            "Replica kill events applied to the training membership view")
+        self._g_replicas = reg.gauge(
+            "elastic_replicas", "Alive training replicas (data-axis size)")
+        self._g_replicas.set(float(len(self._alive)))
+        super().__init__(self._build_wrapper, checkpoint, health=health,
+                         monitor=monitor)
+
+    # ------------------------------------------------------------ topology
+    def _alive_members(self):
+        """Alive member names that map to a known device slot, in slot
+        order (a stable device order keeps the mesh deterministic)."""
+        alive = [n for n in self.membership.alive() if n in self._device_of]
+        return sorted(alive, key=lambda n: int(n[1:]) if n[1:].isdigit()
+                      else n)
+
+    def _build_wrapper(self):
+        """Factory handed to FaultTolerantTrainer: a ZeRO ShardedTrainer
+        over the CURRENT alive mesh — restores therefore land re-sharded
+        for whatever topology this process has now."""
+        from ..parallel.sharding import ShardedTrainer, make_mesh
+        devs = [self._device_of[n] for n in self._alive]
+        mesh = make_mesh(n_data=len(devs), devices=devs)
+        return ShardedTrainer(self._net_factory(), mesh=mesh,
+                              rules=self.rules, shard_update=True)
+
+    def _probe_detail(self):
+        return {"replicas": len(self._alive), "reshards": self.reshards,
+                "membership": self.membership.status()}
+
+    def poll_membership(self):
+        """One elasticity tick (run between batches via the fit loop's
+        _before_batch hook, callable by external drivers too): beat the
+        owned members, apply due chaos preemptions, and re-shard if the
+        alive set changed. Returns True when a re-shard happened.
+
+        The alive set is recomputed every tick — never gated on the view's
+        version counter — because ttl staleness is a *clock* transition:
+        an externally-beaten member going silent changes alive() without
+        any version bump, and that silent death must re-shard too."""
+        step = self.state["iteration"]
+        if self._owns_view:
+            for name in self.membership.members():
+                self.membership.heartbeat(name)
+        if self.plan is not None:
+            for ev in self.plan.poll_preemptions(step):
+                if ev["target"] not in self._device_of:
+                    continue
+                if ev["action"] == "kill":
+                    if self.membership.kill(ev["target"]):
+                        self._m_preempt.inc(1)
+                        self.preemption_events.append(ev)
+                        self.logger.warning("replica_preempted",
+                                            replica=ev["target"],
+                                            rule=ev["rule"], step=step)
+                elif ev["target"] in self.membership.members():
+                    # unknown-to-the-view targets are skipped like kill()
+                    # skips them (an external view may not carry this
+                    # member at all); revive() raising would kill the run
+                    if self.membership.revive(ev["target"]):
+                        self.preemption_events.append(ev)
+                        self.logger.info("replica_revived",
+                                         replica=ev["target"],
+                                         rule=ev["rule"], step=step)
+        alive = self._alive_members()
+        if alive == self._alive:
+            return False
+        return self._reshard(alive)
+
+    _before_batch = poll_membership
+
+    def _reshard(self, alive):
+        """Re-shape the live run onto `alive`'s devices: same network
+        object, same params, moments converted old-sharded -> canonical ->
+        new-sharded (set_update_sharding inside the new ShardedTrainer), so
+        the next batch trains with momentum intact. No checkpoint, no halt."""
+        from ..parallel.sharding import ShardedTrainer, make_mesh
+        if len(alive) < self.min_replicas:
+            path = self.checkpoint()
+            raise ElasticImpossible(
+                f"{len(alive)} alive replicas < min_replicas="
+                f"{self.min_replicas}; checkpointed at {path}")
+        old_n, new_n = len(self._alive), len(alive)
+        direction = "shrink" if new_n < old_n else "grow"
+        with get_tracer().span("elastic_reshard", replicas_from=old_n,
+                               replicas_to=new_n, direction=direction):
+            t0 = monotonic_s()
+            net = self._net()
+            devs = [self._device_of[n] for n in alive]
+            mesh = make_mesh(n_data=len(devs), devices=devs)
+            self.model = ShardedTrainer(net, mesh=mesh, rules=self.rules,
+                                        shard_update=True)
+            self.logger.info("elastic_reshard", replicas_from=old_n,
+                             replicas_to=new_n, direction=direction,
+                             iteration=self.state["iteration"],
+                             reshard_ms=(monotonic_s() - t0) * 1000.0)
+        self._alive = alive
+        self.reshards += 1
+        self._m_reshards.inc(1, direction=direction)
+        self._g_replicas.set(float(new_n))
+        return True
+
+    # fit() is inherited verbatim: the base FaultTolerantTrainer loop calls
+    # the _before_batch hook (= poll_membership here) between batches, so
+    # resume/checkpoint/halt fixes in the base apply to elastic runs too.
+    # A killed replica re-shards the run in place; only membership below
+    # min_replicas still checkpoints-and-raises (ElasticImpossible).

@@ -19,6 +19,14 @@ and URL substring and injects one failure mode:
                    black-holed peer costs the caller, without the wait.
 - ``unhealthy``  — a canned deep-health 503 (`{"health": "unhealthy", ...}`)
                    so health-aware routers eject the replica.
+- ``preempt``    — NOT an HTTP fault: kills a *named replica/worker* at a
+                   deterministic step (`target` + `at_step`), optionally
+                   reviving it after `cooldown_s` on the injected clock.
+                   Elastic consumers (elastic.ElasticTrainer, the autoscale
+                   smoke) poll `FaultPlan.poll_preemptions(step)` each step
+                   and apply the returned kill/revive events to their
+                   membership view or ReplicaLauncher; the HTTP interceptor
+                   ignores these rules entirely.
 
 Rules fire deterministically: `after` skips the first N matches, `count`
 bounds total injections, `probability` draws from the plan's seeded RNG.
@@ -39,7 +47,7 @@ import threading
 
 from .policy import advance_aware_sleep
 
-KINDS = ("latency", "error", "reset", "wedge", "unhealthy")
+KINDS = ("latency", "error", "reset", "wedge", "unhealthy", "preempt")
 
 _UNHEALTHY_BODY = {"status": "unhealthy", "health": "unhealthy",
                    "components": {"chaos": {"status": "unhealthy",
@@ -52,7 +60,8 @@ class FaultRule:
 
     def __init__(self, kind, match="", method=None, status=500,
                  latency_s=0.0, after=0, count=None, probability=1.0,
-                 body=None, name=None, active=True):
+                 body=None, name=None, active=True, target=None,
+                 at_step=None, cooldown_s=None):
         if kind not in KINDS:
             raise ValueError(f"unknown fault kind {kind!r} (one of {KINDS})")
         self.kind = str(kind)
@@ -66,18 +75,36 @@ class FaultRule:
         self.body = body
         self.name = str(name) if name is not None else self.kind
         self.active = bool(active)
+        # preempt-kind scripting: kill `target` once step >= at_step, revive
+        # once cooldown_s has elapsed on the injected clock (None: stay dead)
+        self.target = None if target is None else str(target)
+        self.at_step = None if at_step is None else int(at_step)
+        self.cooldown_s = None if cooldown_s is None else float(cooldown_s)
+        if self.kind == "preempt" and (self.target is None
+                                       or self.at_step is None):
+            raise ValueError("preempt rule needs target= and at_step=")
         self.seen = 0            # matching requests observed
         self.injected = 0        # faults actually fired
+        self.preempted_at = None  # monotonic_s of the kill (preempt kind)
+        self.revived = False
 
     def matches(self, method, url) -> bool:
-        if not self.active:
-            return False
+        if not self.active or self.kind == "preempt":
+            return False         # preempt is step-scripted, never HTTP-matched
         if self.method is not None and method != self.method:
             return False
         return self.match in url
 
     # -- declarative round-trip ---------------------------------------------
     def to_dict(self):
+        if self.kind == "preempt":
+            d = {"kind": self.kind, "name": self.name,
+                 "target": self.target, "at_step": self.at_step}
+            if self.cooldown_s is not None:
+                d["cooldown_s"] = self.cooldown_s
+            if not self.active:
+                d["active"] = False
+            return d
         d = {"kind": self.kind, "match": self.match, "name": self.name}
         if self.method is not None:
             d["method"] = self.method
@@ -160,6 +187,38 @@ class FaultPlan:
         if n == 0:
             raise KeyError(f"no fault rule named {name!r}")
         return n
+
+    def poll_preemptions(self, step):
+        """Fire due `preempt` rules for training/controller step `step`;
+        returns the membership events to apply, in rule order:
+
+            [{"action": "kill"|"revive", "target": name, "rule": name,
+              "step": step}, ...]
+
+        A rule kills its target exactly once when `step >= at_step`, and —
+        when `cooldown_s` is set — revives it exactly once after that much
+        time has elapsed on the injected clock (a ManualClock test advances;
+        real runs wait). The HTTP interceptor never sees these rules; the
+        elastic consumers (ElasticTrainer's membership poll, the autoscale
+        smoke's launcher kill) drive this method once per step/tick."""
+        from ..util.time_source import monotonic_s
+        events = []
+        with self._lock:
+            for r in self.rules:
+                if r.kind != "preempt" or not r.active:
+                    continue
+                if r.preempted_at is None and step >= r.at_step:
+                    r.preempted_at = monotonic_s()
+                    r.injected += 1
+                    events.append({"action": "kill", "target": r.target,
+                                   "rule": r.name, "step": int(step)})
+                elif (r.preempted_at is not None and not r.revived
+                      and r.cooldown_s is not None
+                      and monotonic_s() - r.preempted_at >= r.cooldown_s):
+                    r.revived = True
+                    events.append({"action": "revive", "target": r.target,
+                                   "rule": r.name, "step": int(step)})
+        return events
 
     def injected(self):
         """{rule name: injections so far} — assertable chaos accounting."""

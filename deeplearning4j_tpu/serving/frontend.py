@@ -22,7 +22,10 @@ user traffic:
 - **Single-failover retry.** A failed `/predict` attempt (reset, timeout,
   5xx, 429, open breaker) fails over ONCE to a different replica — POST
   /predict is idempotent by contract; non-idempotent routes (`/deploy`,
-  `/rollback`) are never retried. The whole request runs under one
+  `/rollback`) are never retried. `max_attempts=` widens the budget for
+  larger pools, and a ONE-replica pool retries the same replica once
+  (nowhere to fail over; a transient fault must not guarantee a 502).
+  A pool-wide admission shed is forwarded as the real 429, never a 502. The whole request runs under one
   resilience.Deadline, so the failover can't double the caller's worst-case
   latency, and every attempt is a child span carrying `retry`/`failover`
   attributes under the frontend's server span — the inbound `traceparent`
@@ -137,8 +140,13 @@ class FleetFrontend(BackgroundHttpServer):
                  alert_rules=None, alert_sinks=None, alert_interval_s=5.0,
                  canary_opts=None, broker=None,
                  broker_topic="registry_events", session_id="frontend",
-                 tracer=None, log_sinks=None):
+                 tracer=None, log_sinks=None, max_attempts=None):
         super().__init__(host=host, port=port)
+        # real attempts per routed request (initial try + failovers); POST
+        # /predict //generate are idempotent by contract, so a larger pool
+        # can afford more than the single-failover default
+        self.max_attempts = int(max_attempts) if max_attempts is not None \
+            else self.MAX_ATTEMPTS
         urls = [str(u).rstrip("/") for u in replicas]
         if not urls:
             raise ValueError("frontend needs at least one replica")
@@ -156,11 +164,14 @@ class FleetFrontend(BackgroundHttpServer):
                                        sinks=log_sinks)
         self.registry.logger = self.logger
 
+        # kept for add_replica: elastically-launched replicas get a breaker
+        # configured exactly like the construction-time pool's
+        self._breaker_opts = dict(failure_ratio=breaker_failure_ratio,
+                                  window=breaker_window,
+                                  min_calls=breaker_min_calls,
+                                  open_for_s=breaker_open_for_s)
         self.replicas = [
-            ReplicaHandle(n, u, CircuitBreaker(
-                failure_ratio=breaker_failure_ratio, window=breaker_window,
-                min_calls=breaker_min_calls, open_for_s=breaker_open_for_s,
-                name=n, on_transition=self._on_breaker_transition))
+            ReplicaHandle(n, u, self._make_breaker(n))
             for n, u in zip(names, urls)]
 
         self.health_interval_s = float(health_interval_s)
@@ -232,6 +243,49 @@ class FleetFrontend(BackgroundHttpServer):
         self.broker_topic = str(broker_topic)
         from .canary import CanaryController
         self.canary = CanaryController(self, **(canary_opts or {}))
+
+    # ---- elastic pool membership -------------------------------------------
+    def _make_breaker(self, name):
+        return CircuitBreaker(name=name,
+                              on_transition=self._on_breaker_transition,
+                              **self._breaker_opts)
+
+    def add_replica(self, url, name=None, cohort=STABLE):
+        """Admit a new replica to the pool at runtime (the autoscale
+        scale-up path): it gets a fresh breaker with the pool's settings, a
+        health probe, and "unknown" health (full routing weight) until the
+        next poll sweep. Returns the ReplicaHandle."""
+        url = str(url).rstrip("/")
+        name = str(name) if name else _replica_name(url)
+        with self._route_lock:
+            if any(r.name == name for r in self.replicas):
+                raise ValueError(f"duplicate replica name {name!r}")
+            handle = ReplicaHandle(name, url, self._make_breaker(name))
+            handle.cohort = cohort
+            # replace, never mutate: readers iterate a consistent snapshot
+            self.replicas = self.replicas + [handle]
+        self.health.register(f"replica:{name}", self._replica_probe(handle))
+        self.logger.info("replica_added", replica=name, url=url,
+                         pool_size=len(self.replicas))
+        return handle
+
+    def remove_replica(self, name):
+        """Withdraw a replica from the pool (scale-down drain or dead-
+        replica cleanup): no new requests route to it from this call on;
+        in-flight attempts finish against the still-running server (the
+        launcher drains/stops it afterwards). Returns the removed handle."""
+        with self._route_lock:
+            handle = next((r for r in self.replicas if r.name == name), None)
+            if handle is None:
+                raise KeyError(f"unknown replica {name!r}")
+            remaining = [r for r in self.replicas if r is not handle]
+            if not remaining:
+                raise ValueError("cannot remove the last replica")
+            self.replicas = remaining
+        self.health.unregister(f"replica:{name}")
+        self.logger.info("replica_removed", replica=name,
+                         pool_size=len(self.replicas))
+        return handle
 
     # ---- health pool -------------------------------------------------------
     def _on_breaker_transition(self, breaker, old, new):
@@ -372,9 +426,15 @@ class FleetFrontend(BackgroundHttpServer):
             candidates = self._pick_candidates()
             if not candidates:
                 return 503, {"error": "no routable replica"}
+            if len(candidates) == 1:
+                # a one-replica pool has nowhere to fail over, but the
+                # route is idempotent: a transient transport fault deserves
+                # one bounded retry against the same replica rather than a
+                # guaranteed 502 (the breaker still records both outcomes)
+                candidates = candidates * self.max_attempts
             last_exc, attempts = None, 0
             for replica in candidates:
-                if attempts >= self.MAX_ATTEMPTS:
+                if attempts >= self.max_attempts:
                     break
                 if not replica.breaker.allow():
                     continue        # half-open probe slots busy: next target
@@ -418,6 +478,16 @@ class FleetFrontend(BackgroundHttpServer):
                          "attempts": attempts}
         if last_exc is None:
             return 503, {"error": "all replicas breaker-open"}
+        if isinstance(last_exc, urllib.error.HTTPError) \
+                and last_exc.code == 429:
+            # every attempted replica shed: the pool is genuinely over
+            # capacity, and admission's "slow down" answer must reach the
+            # client AS backpressure (429 + Retry-After), not dressed up as
+            # a 502 server fault — retry policies and the autoscaler's shed
+            # signal both key on the real status
+            code, body = self._client_error(last_exc)
+            return code, {**(body if isinstance(body, dict) else
+                             {"error": str(body)}), "attempts": attempts}
         return 502, {"error": f"{type(last_exc).__name__}: {last_exc}",
                      "attempts": attempts}
 
@@ -618,8 +688,12 @@ class RegistrySubscriber:
     have just landed), `scan` refreshes, `rollback` rolls back. A failing
     apply is recorded and counted, never fatal to the loop."""
 
-    def __init__(self, server, client, topic="registry_events",
+    def __init__(self, server, client=None, topic="registry_events",
                  poll_timeout_s=0.5):
+        """`client=None` builds an apply-only subscriber: `apply(event)`
+        works (the elastic launcher replays the newest deploy event through
+        it synchronously so a fresh replica comes up warm), but there is no
+        broker loop to start."""
         self.server = server
         self.client = client
         self.topic = str(topic)
@@ -670,6 +744,9 @@ class RegistrySubscriber:
                                 "event": event})
 
     def start(self):
+        if self.client is None:
+            raise ValueError("apply-only subscriber (client=None) has no "
+                             "broker loop to start")
         if self._thread is not None and self._thread.is_alive():
             return self
         self._stop.clear()
@@ -683,4 +760,5 @@ class RegistrySubscriber:
         if self._thread is not None:
             self._thread.join(timeout)
             self._thread = None
-        self.client.close()
+        if self.client is not None:
+            self.client.close()

@@ -313,6 +313,14 @@ class FleetCollector:
                 word = _health_word(st.get("healthz"))
                 comp = {"status": word, "url": st["url"],
                         "code": st.get("healthz_code")}
+                body = st.get("healthz")
+                if isinstance(body, dict) and \
+                        isinstance(body.get("components"), dict):
+                    # the peer's own component map rides along: an elastic
+                    # trainer's membership/iteration probe (or a replica's
+                    # batcher/registry detail) is answerable from ONE
+                    # /fleet/healthz scrape instead of a per-host hop
+                    comp["components"] = body["components"]
             components[name] = comp
             if _RANK[comp["status"]] > _RANK[overall]:
                 overall = comp["status"]

@@ -56,7 +56,7 @@ class FaultTolerantTrainer:
     SHARDED_DIR = "model_sharded"
 
     def __init__(self, model_or_factory, checkpoint: CheckpointConfig,
-                 health=None):
+                 health=None, monitor=None):
         """`health`: a TrainingHealthListener (optimize.listeners) — the
         trainer attaches it to the model and, when a fatal condition trips
         (NaN loss/gradients, divergence), writes one final QUARANTINED
@@ -64,15 +64,30 @@ class FaultTolerantTrainer:
         restored — its params are the corrupted/diverged state) and raises
         TrainingHalted instead of burning accelerator hours on a dead run.
         Restarting resumes from the newest periodic `ckpt-*` checkpoint,
-        which predates the blow-up."""
+        which predates the blow-up.
+
+        `monitor`: the telemetry.health.HealthMonitor this trainer's
+        liveness probe registers into (default: the process monitor, the
+        one UIServer /healthz — and so /fleet/healthz — aggregates). The
+        probe carries iteration/heartbeat state and is re-registered on the
+        restore path too, so a RESUMED run is immediately visible to the
+        fleet plane instead of silently losing its membership entry; pass
+        monitor=False to opt out entirely."""
         self.ckpt = checkpoint
         os.makedirs(self.ckpt.directory, exist_ok=True)
         self._factory = (model_or_factory if callable(model_or_factory)
                          else (lambda: model_or_factory))
         self.model = None
         self.health = health
+        if monitor is None:
+            from ..telemetry.health import get_monitor
+            monitor = get_monitor()
+        self.monitor = monitor or None     # False -> None (no probe)
+        self.health_key = None
+        self._last_beat = None
         self.state = {"epoch": 0, "batch": 0, "iteration": 0, "rng": None}
         self._restored = self._try_restore()
+        self._register_probe()
 
     def _net(self):
         """The serializable network under self.model. A trainer wrapper
@@ -212,7 +227,58 @@ class FaultTolerantTrainer:
     def resumed(self):
         return self._restored
 
+    # ------------------------------------------------------------ liveness
+    def _register_probe(self):
+        """(Re-)register the trainer's health probe + heartbeat state. Runs
+        at construction — AFTER _try_restore, so the restore path (which
+        rebuilds self.model via adopt and previously surfaced nowhere)
+        re-registers too and a resumed run shows up on /healthz //fleet
+        immediately, at its restored iteration. A restore primes the
+        heartbeat so the probe reports a live (not never-beaten) trainer."""
+        if self.monitor is None:
+            return
+        if self._restored:
+            self._touch_beat()
+        if self.health_key is not None:
+            self.monitor.unregister(self.health_key)
+        self.health_key = self.monitor.register_unique("trainer", self._probe)
+        return self.health_key
+
+    def unregister_probe(self):
+        """Withdraw the liveness probe (a driver shutting the run down)."""
+        if self.monitor is not None and self.health_key is not None:
+            self.monitor.unregister(self.health_key)
+            self.health_key = None
+
+    def _touch_beat(self):
+        self._last_beat = monotonic_s()
+
+    def _probe_detail(self):
+        """Extra probe fields; subclasses (ElasticTrainer) extend."""
+        return {}
+
+    def _probe(self):
+        halted = self.health is not None and \
+            getattr(self.health, "should_halt", False)
+        status = "unhealthy" if halted else "healthy"
+        beat_age = None if self._last_beat is None \
+            else monotonic_s() - self._last_beat
+        detail = {"iteration": self.state["iteration"],
+                  "epoch": self.state["epoch"],
+                  "resumed": self._restored,
+                  "last_step_age_s": beat_age,
+                  **self._probe_detail()}
+        if halted:
+            detail["reason"] = getattr(self.health, "trip_reason", "halted")
+        return status, detail
+
     # ------------------------------------------------------------ training
+    def _before_batch(self):
+        """Hook run between batches (before each fit_batch). The elastic
+        policy (elastic.ElasticTrainer) overrides this with its membership
+        poll/re-shard; the base trainer does nothing — keeping ONE fit
+        loop so resume/checkpoint/halt fixes apply to every policy."""
+
     def fit(self, iterator, epochs=1):
         """Train with checkpoints every `frequency` iterations; on resume,
         fast-forwards past the batches the dead process already consumed.
@@ -234,7 +300,9 @@ class FaultTolerantTrainer:
                 if b < skip:
                     b += 1
                     continue
+                self._before_batch()
                 self.model.fit_batch(ds)
+                self._touch_beat()
                 b += 1
                 self.state.update(epoch=epoch, batch=b,
                                   iteration=self.state["iteration"] + 1)

@@ -263,6 +263,15 @@ class QuietHandler(BaseHTTPRequestHandler):
         return read_body(self)
 
 
+class _BurstTolerantHTTPServer(ThreadingHTTPServer):
+    # socketserver's default listen backlog is 5: an open-loop burst (the
+    # loadgen ramp, a thundering-herd reconnect) RSTs the overflow and the
+    # client sees a transport fault that looks exactly like a dead server.
+    # A deeper backlog turns that into queueing — admission control (429)
+    # stays the one intentional shedding point.
+    request_queue_size = 128
+
+
 class BackgroundHttpServer:
     """Owns the ThreadingHTTPServer lifecycle; subclass-or-compose with a
     handler class (usually a QuietHandler subclass closing over the owner)."""
@@ -274,7 +283,8 @@ class BackgroundHttpServer:
         self._thread = None
 
     def start_with(self, handler_cls):
-        self._httpd = ThreadingHTTPServer((self.host, self.port), handler_cls)
+        self._httpd = _BurstTolerantHTTPServer((self.host, self.port),
+                                               handler_cls)
         self.port = self._httpd.server_address[1]
         self._thread = threading.Thread(target=self._httpd.serve_forever,
                                         daemon=True)

@@ -154,3 +154,76 @@ def test_checkpoint_resume_sharded_format(tmp_path):
     ref.fit(it, epochs=2)
     np.testing.assert_allclose(ref.model.get_flat_params(),
                                t2.model.get_flat_params(), rtol=1e-6, atol=1e-7)
+
+
+def test_trainer_health_probe_survives_restore(tmp_path):
+    """Elastic-fleet satellite regression: the trainer registers a liveness
+    probe into the health monitor, and the RESTORE path re-registers it
+    with primed heartbeat state — a resumed run is immediately visible on
+    /healthz (and so /fleet/healthz), at its restored iteration, instead
+    of silently losing its membership entry."""
+    from deeplearning4j_tpu.telemetry.health import HealthMonitor
+
+    X, Y = _data()
+    it = ListDataSetIterator(DataSet(X, Y), batch_size=8)
+    ck = CheckpointConfig(tmp_path / "ck", frequency=7)
+
+    m1 = HealthMonitor()
+    t1 = FaultTolerantTrainer(_factory(), ck, monitor=m1)
+    assert t1.health_key in m1.components()
+    comp = m1.check()["components"][t1.health_key]
+    assert comp["status"] == "healthy" and comp["iteration"] == 0
+    assert comp["resumed"] is False and comp["last_step_age_s"] is None
+    t1.fit(it, epochs=1)
+    comp = m1.check()["components"][t1.health_key]
+    assert comp["iteration"] == 10 and comp["last_step_age_s"] is not None
+
+    # a restarted process: fresh monitor, fresh trainer, same directory —
+    # the probe must be re-registered and report the restored state as a
+    # LIVE (heartbeat-primed) member
+    m2 = HealthMonitor()
+    t2 = FaultTolerantTrainer(_factory(), ck, monitor=m2)
+    assert t2.resumed
+    comp = m2.check()["components"][t2.health_key]
+    assert comp["status"] == "healthy"
+    assert comp["iteration"] == 10 and comp["resumed"] is True
+    assert comp["last_step_age_s"] is not None
+
+    # probe withdrawal for drivers that shut the run down
+    t2.unregister_probe()
+    assert t2.health_key is None and m2.components() == []
+    # monitor=False opts out entirely
+    t3 = FaultTolerantTrainer(_factory(), ck, monitor=False)
+    assert t3.monitor is None and t3.health_key is None
+
+
+def test_trainer_probe_visible_through_fleet_healthz(tmp_path):
+    """The probe lands on the PROCESS monitor by default, which UIServer
+    /healthz aggregates and FleetCollector scrapes — a training run shows
+    up on /fleet/healthz with its iteration/heartbeat detail."""
+    from deeplearning4j_tpu.telemetry.fleet import FleetServer
+    from deeplearning4j_tpu.ui.server import UIServer
+    from deeplearning4j_tpu.util.http import get_json
+
+    X, Y = _data(n=40)
+    it = ListDataSetIterator(DataSet(X, Y), batch_size=8)
+    trainer = FaultTolerantTrainer(_factory(),
+                                   CheckpointConfig(tmp_path / "ck",
+                                                    frequency=0))
+    try:
+        trainer.fit(it, epochs=1)
+        ui = UIServer(port=0).start()
+        fleet = FleetServer([ui.url], names=["trainer-host"],
+                            interval_s=0.0).start()
+        try:
+            report = get_json(fleet.url + "/fleet/healthz", timeout=30)
+            host = report["components"]["trainer-host"]
+            assert host["status"] == "healthy"
+            comps = host["components"]
+            assert trainer.health_key in comps
+            assert comps[trainer.health_key]["iteration"] == 5
+        finally:
+            fleet.stop()
+            ui.stop()
+    finally:
+        trainer.unregister_probe()

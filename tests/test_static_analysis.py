@@ -107,6 +107,15 @@ def greedy_decode(model, params, ids, steps):
         pad = jnp.zeros((len(toks),))
     return toks
 """, [7, 8]),
+    "GL012": ("""\
+import threading
+
+class Pool:
+    def refill(self):
+        while self.need_more():
+            t = threading.Thread(target=self.work, daemon=True)
+            t.start()
+""", [6]),
 }
 
 
@@ -632,6 +641,88 @@ def beam_decode(model, ids, n):
     assert lint(lenout, rules=["GL011"]) == []
 
 
+def test_gl012_edges():
+    # a visible max-count guard in the spawning function is quiet
+    guarded = ("""\
+import threading
+
+class Pool:
+    def refill(self):
+        while self.need_more():
+            if len(self._workers) >= self.max_workers:
+                break
+            t = threading.Thread(target=self.work, daemon=True)
+            t.start()
+""")
+    assert lint(guarded, rules=["GL012"]) == []
+    # a non-blocking semaphore try-acquire is a bound too (loadgen idiom)
+    sem = ("""\
+import threading
+
+def pump(jobs, inflight):
+    while jobs:
+        if not inflight.acquire(blocking=False):
+            continue
+        threading.Thread(target=jobs.pop, daemon=True).start()
+""")
+    assert lint(sem, rules=["GL012"]) == []
+    # for-loop spawns are bounded by the iterable (_fan_out / worker pools)
+    fan = ("""\
+import threading
+
+def fan_out(targets, fn):
+    threads = [threading.Thread(target=fn, args=(t,), daemon=True)
+               for t in targets]
+    for t in threads:
+        t.start()
+""")
+    assert lint(fan, rules=["GL012"]) == []
+    # the launcher SPI module owns spawn (and its max_replicas wall)
+    bare = ("""\
+import threading
+
+def respawn_loop(self):
+    while True:
+        threading.Thread(target=self.serve, daemon=True).start()
+""")
+    assert lint(bare, rel_path="deeplearning4j_tpu/elastic/launcher.py",
+                rules=["GL012"]) == []
+    # subprocess.Popen in an unguarded while loop fires like Thread
+    popen = ("""\
+import subprocess, sys
+
+def keep_alive(cmd):
+    while True:
+        proc = subprocess.Popen([sys.executable] + cmd)
+        proc.wait()
+""")
+    [v] = lint(popen, rules=["GL012"])
+    assert v.rule == "GL012" and v.line == 5
+    # an innermost def with its own guard is judged on its own body, even
+    # defined inside someone else's unbounded loop
+    nested = ("""\
+import threading
+
+def outer(self):
+    while True:
+        def spawn_some(n):
+            while len(self._threads) < self.max_threads:
+                threading.Thread(target=self.work, daemon=True).start()
+        spawn_some(2)
+""")
+    assert lint(nested, rules=["GL012"]) == []
+
+
+def test_gl012_repo_spawn_sites_are_bounded():
+    """Satellite gate: the whole package + tools (the elastic subsystem,
+    the loadgen, every worker pool) obeys the spawn bound — zero GL012
+    findings, zero baselined remainders."""
+    report = Analyzer(rules=[get_rule("GL012")], root=str(REPO)).analyze_paths(
+        ["deeplearning4j_tpu", "tools"])
+    assert report.errors == []
+    assert report.violations == [], [str(v) for v in report.violations]
+
+
 def test_gl011_repo_decode_paths_are_clean():
     """Satellite gate: the decode subsystem itself (and everything else in
     the package + tools) obeys its own rule — zero GL011 findings, zero
@@ -787,7 +878,7 @@ def test_cli_rule_subset_and_list_rules():
         assert rule.id in proc.stdout and rule.rationale
     assert [r.id for r in all_rules()] == \
         ["GL001", "GL002", "GL003", "GL004", "GL005", "GL006", "GL007",
-         "GL008", "GL009", "GL010", "GL011"]
+         "GL008", "GL009", "GL010", "GL011", "GL012"]
 
 
 def test_repo_gate_is_clean_and_fast():

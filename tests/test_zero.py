@@ -389,3 +389,135 @@ def test_zero_tx_honors_partial_update_contract():
     assert set(ups) == {"1"} and set(new_state) == {"1"}
     for k, u in ups["1"].items():
         assert u.shape == net.params["1"][k].shape
+
+
+# ----------------------------------------------------- re-shard edge cases
+# (elastic-fleet satellites: ElasticTrainer re-shards a LIVE run through
+# set_update_sharding — canonical conversion must be bit-exact through the
+# degenerate single-shard mesh, growth past the original shard count, and
+# chains of consecutive re-shards.)
+
+def _canonical_moments(net):
+    """Canonical (per-param) updater state as a flat {path: np.array}."""
+    st = net.opt_state
+    z = getattr(net, "_zero", None)
+    if z is not None:
+        st = z.to_canonical(st, net.params)
+    out = {}
+    for path, leaf in jax.tree_util.tree_flatten_with_path(st)[0]:
+        if hasattr(leaf, "shape"):
+            out["/".join(str(k) for k in path)] = np.asarray(leaf)
+    return out
+
+
+def _reshard(net, n):
+    devs = jax.devices()[:n]
+    return ShardedTrainer(net, mesh=make_mesh(n_data=n, devices=devs),
+                          shard_update=True)
+
+
+def _assert_moments_bitwise(net, oracle):
+    a, b = _canonical_moments(net), _canonical_moments(oracle)
+    assert a.keys() == b.keys()
+    for k in a:
+        np.testing.assert_array_equal(a[k], b[k], err_msg=k)
+
+
+def test_zero_reshard_shrink_to_single_shard_degenerate():
+    """8 shards -> 1 (the degenerate mesh: no partitioning at all) keeps
+    every moment BIT-identical to a never-resharded run, and training
+    continues producing the same params."""
+    X, Y = _toy()
+    ds = DataSet(X, Y)
+    oracle = MultiLayerNetwork(_conf()).init()
+    otr = ShardedTrainer(oracle, mesh=make_mesh(n_data=8), shard_update=True)
+    net = MultiLayerNetwork(_conf()).init()
+    tr = _reshard(net, 8)
+    for _ in range(4):
+        otr.fit_batch(ds)
+        tr.fit_batch(ds)
+    tr = _reshard(net, 1)
+    _assert_moments_bitwise(net, oracle)
+    for _ in range(3):
+        otr.fit_batch(ds)
+        tr.fit_batch(ds)
+    np.testing.assert_allclose(oracle.get_flat_params(),
+                               net.get_flat_params(), rtol=1e-5, atol=1e-6)
+
+
+def test_zero_reshard_grow_past_original_count():
+    """2 shards -> 8 (more shards than the run ever had: every flat moment
+    re-pads to the larger multiple, incl. the [3] bias padding 4 -> 8):
+    moments stay bit-identical, training parity holds."""
+    X, Y = _toy()
+    ds = DataSet(X, Y)
+    oracle = MultiLayerNetwork(_conf()).init()
+    otr = ShardedTrainer(oracle, mesh=make_mesh(n_data=2,
+                                                devices=jax.devices()[:2]),
+                         shard_update=True)
+    net = MultiLayerNetwork(_conf()).init()
+    tr = _reshard(net, 2)
+    for _ in range(4):
+        otr.fit_batch(ds)
+        tr.fit_batch(ds)
+    tr = _reshard(net, 8)
+    _assert_moments_bitwise(net, oracle)
+    for _ in range(3):
+        otr.fit_batch(ds)
+        tr.fit_batch(ds)
+    np.testing.assert_allclose(oracle.get_flat_params(),
+                               net.get_flat_params(), rtol=1e-5, atol=1e-6)
+
+
+def test_zero_two_consecutive_reshards_bit_parity():
+    """8 -> 4 -> 8 back to back (no steps in between): the canonical
+    conversion CHAIN is bit-exact — two consecutive re-shards leave every
+    moment identical to the never-resharded run's."""
+    X, Y = _toy()
+    ds = DataSet(X, Y)
+    oracle = MultiLayerNetwork(_conf()).init()
+    otr = ShardedTrainer(oracle, mesh=make_mesh(n_data=8), shard_update=True)
+    net = MultiLayerNetwork(_conf()).init()
+    tr = _reshard(net, 8)
+    for _ in range(4):
+        otr.fit_batch(ds)
+        tr.fit_batch(ds)
+    tr = _reshard(net, 4)          # replica loss...
+    tr = _reshard(net, 8)          # ...immediately regained
+    _assert_moments_bitwise(net, oracle)
+    for _ in range(2):
+        otr.fit_batch(ds)
+        tr.fit_batch(ds)
+    np.testing.assert_allclose(oracle.get_flat_params(),
+                               net.get_flat_params(), rtol=1e-5, atol=1e-6)
+
+
+def test_zero_reshards_with_training_between_f32_parity():
+    """The full elastic lose-then-regain arc WITH steps at each topology
+    (8 -> 4 -> 8): moments cannot stay bitwise across a different
+    all-reduce tree, but params and canonical moments track the fixed-
+    topology run within f32 tolerance — momentum is intact, not reset."""
+    X, Y = _toy()
+    ds = DataSet(X, Y)
+    oracle = MultiLayerNetwork(_conf()).init()
+    otr = ShardedTrainer(oracle, mesh=make_mesh(n_data=8), shard_update=True)
+    net = MultiLayerNetwork(_conf()).init()
+    tr = _reshard(net, 8)
+    for _ in range(3):
+        otr.fit_batch(ds)
+        tr.fit_batch(ds)
+    tr = _reshard(net, 4)          # replica loss
+    for _ in range(3):
+        otr.fit_batch(ds)
+        tr.fit_batch(ds)
+    tr = _reshard(net, 8)          # replicas regained
+    for _ in range(2):
+        otr.fit_batch(ds)
+        tr.fit_batch(ds)
+    a, b = _canonical_moments(net), _canonical_moments(oracle)
+    assert a.keys() == b.keys()
+    for k in a:
+        np.testing.assert_allclose(a[k], b[k], rtol=1e-4, atol=1e-6,
+                                   err_msg=k)
+    np.testing.assert_allclose(oracle.get_flat_params(),
+                               net.get_flat_params(), rtol=1e-5, atol=1e-6)
