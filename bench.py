@@ -754,6 +754,50 @@ def bench_loadgen(rate=300.0, duration_s=2.0, n_replicas=3, seed=0):
     return out
 
 
+def bench_ckpt(hidden=1024, reps=7):
+    """Durable-checkpoint cost (the robustness PR's measurable win): what
+    the TRAINING THREAD pays per checkpoint, async (one host device-get
+    snapshot, serialize+fsync+publish on the writer thread) vs sync (the
+    whole write inline). `ckpt_blocking_ms` p50 must sit strictly below the
+    synchronous write time — the regression guard in main(). Writer-side
+    cost reported as `ckpt_write_ms` from the registry histogram."""
+    from deeplearning4j_tpu.telemetry.registry import get_registry
+    from deeplearning4j_tpu.train import CheckpointConfig, FaultTolerantTrainer
+    from deeplearning4j_tpu.zoo.models import mlp_mnist
+
+    def run(async_write, d):
+        t = FaultTolerantTrainer(
+            lambda: mlp_mnist(hidden=hidden),
+            CheckpointConfig(d, frequency=0, keep_last=2,
+                             async_write=async_write),
+            monitor=False)
+        # prime optimizer state so the checkpoint carries realistic bytes
+        rng = np.random.default_rng(0)
+        x = rng.random((64, 784)).astype(np.float32)
+        y = np.eye(10, dtype=np.float32)[rng.integers(0, 10, 64)]
+        from deeplearning4j_tpu.datasets.dataset import DataSet
+        t.model.fit_batch(DataSet(x, y))
+        times = []
+        for i in range(reps):
+            t.state["iteration"] = i + 1     # distinct dirs, no dedupe
+            t0 = time.perf_counter()
+            t.checkpoint()
+            times.append((time.perf_counter() - t0) * 1e3)
+            # untimed: in a real run the checkpoint interval dwarfs the
+            # write, so the writer idles by the next checkpoint() — without
+            # this the timed call would just join the previous write
+            t.drain_checkpoints()
+        return float(np.median(times))
+
+    with tempfile.TemporaryDirectory() as d:
+        sync_ms = run(False, os.path.join(d, "sync"))
+        blocking_ms = run(True, os.path.join(d, "async"))
+    hist = get_registry().get("ckpt_write_ms")
+    write_ms = hist.percentile(0.5) if hist is not None else None
+    return {"ckpt_blocking_ms": blocking_ms, "ckpt_sync_ms": sync_ms,
+            "ckpt_write_ms": write_ms}
+
+
 # metrics compared against the best prior BENCH_r*.json (higher is better);
 # >30% drops surface in the "regressions" list so relay weather and real
 # regressions are distinguishable at a glance (VERDICT r4 next #5)
@@ -765,7 +809,8 @@ WATCHED_METRICS = ("value", "lenet_samples_per_sec", "char_rnn_chars_per_sec",
 # lower-is-better latency metrics: best prior = the MINIMUM, and a >50%
 # degradation (1.5x the best) lands in "regressions" (wider margin than the
 # throughput 30%: single-request latency is noisier on the shared relay)
-WATCHED_LOWER_METRICS = ("ttft_ms_p50", "decode_itl_ms", "loadgen_p99_ms")
+WATCHED_LOWER_METRICS = ("ttft_ms_p50", "decode_itl_ms", "loadgen_p99_ms",
+                         "ckpt_blocking_ms")
 _RENAMED = {"mnist_real_test_acc": "ucidigits_test_acc"}
 
 
@@ -1042,6 +1087,7 @@ def main():
                ("decode", lambda: bench_decode()),
                ("word2vec", lambda: bench_word2vec()),
                ("loadgen", lambda: bench_loadgen()),
+               ("ckpt", lambda: bench_ckpt()),
                ("scaling", lambda: bench_scaling_subprocess())]
     if headline_is_resnet:
         # e2e ratio only makes sense against a ResNet-50 compute headline,
@@ -1130,6 +1176,11 @@ def main():
                     "spmd_strong_ratio): achieved-vs-offered and p99 are "
                     "the guarded capacity numbers, not a linear-scaling "
                     "claim")
+            elif name == "ckpt":
+                extras["ckpt_blocking_ms"] = round(r["ckpt_blocking_ms"], 2)
+                extras["ckpt_sync_ms"] = round(r["ckpt_sync_ms"], 2)
+                if r["ckpt_write_ms"] is not None:
+                    extras["ckpt_write_ms"] = round(r["ckpt_write_ms"], 2)
             else:
                 extras["spmd_strong_ratio"] = round(r["strong_ratio"], 2)
                 extras["spmd_strong_note"] = (
@@ -1188,6 +1239,17 @@ def main():
              "now": round(float(zr), 2),
              "detail": "ZeRO-sharded step slower than replicated at 8 "
                        "virtual devices"})
+    # durable-checkpoint guard: the async path's blocking time must sit
+    # STRICTLY below the synchronous write — otherwise the background
+    # writer is buying nothing and the training thread re-pays the fsync
+    cb, cs = extras.get("ckpt_blocking_ms"), extras.get("ckpt_sync_ms")
+    if isinstance(cb, (int, float)) and isinstance(cs, (int, float)) \
+            and cb >= cs:
+        out["regressions"].append(
+            {"metric": "ckpt_blocking_ms", "best_prior": round(cs, 2),
+             "now": round(cb, 2),
+             "detail": "async checkpoint blocking time not below the "
+                       "synchronous write time"})
     donation = [str(w.message).splitlines()[0] for w in _caught
                 if "donated buffers were not usable" in str(w.message)]
     _warn_net.__exit__(None, None, None)

@@ -42,12 +42,12 @@ class Baseline:
         return cls(data.get("entries", []))
 
     def save(self, path):
+        # util.fs is stdlib-only, so the jax-free graftlint entry can still
+        # import this module; the durable write keeps a crash mid
+        # --baseline-update from torching the committed baseline
+        from ..util.fs import atomic_write
         data = {"version": VERSION, "entries": self.entries}
-        tmp = path + ".tmp"
-        with open(tmp, "w", encoding="utf-8") as f:
-            json.dump(data, f, indent=1, sort_keys=True)
-            f.write("\n")
-        os.replace(tmp, path)
+        atomic_write(path, json.dumps(data, indent=1, sort_keys=True) + "\n")
 
     # -- matching ------------------------------------------------------------
     @staticmethod

@@ -1,4 +1,4 @@
-"""GL001–GL010: the rule catalog (see RULES.md for the bug-history rationale).
+"""GL001–GL013: the rule catalog (see RULES.md for the bug-history rationale).
 
 Each rule is intra-file AST analysis with light import resolution: aliases
 from ``import x as y`` / ``from m import n as y`` are resolved so
@@ -963,3 +963,42 @@ class UnboundedSpawnRule(Rule):
                             and kw.value.value is False:
                         return True
         return False
+
+
+# ---------------------------------------------------------------------------
+# GL013 — non-durable-publish
+# ---------------------------------------------------------------------------
+
+@register
+class NonDurablePublishRule(Rule):
+    """Bare os.replace publishing a persistent artifact outside util/fs.py."""
+
+    id = "GL013"
+    name = "non-durable-publish"
+    rationale = (
+        "os.replace is atomic in the NAMESPACE but not durable: POSIX only "
+        "promises the rename survives a crash if the file's data was "
+        "fsync'd before it and the parent directory's entry after it. "
+        "Without both, a power loss can publish a name pointing at "
+        "zero-length or stale data — the crash-after-replace bug that "
+        "turned 'the newest checkpoint' into a torn zip. util.fs "
+        "(atomic_write / publish_file / atomic_publish_dir) does the fsync "
+        "dance once, correctly, and feeds the disk-fault chaos seam; a "
+        "deliberately non-durable replace (scratch/cache-only files) "
+        "belongs in the baseline with a note.")
+
+    ALLOW = ("util/fs.py",)
+
+    def check(self, ctx):
+        if ctx.rel_path.endswith(self.ALLOW):
+            return
+        aliases = ctx.aliases
+        for node in ctx.nodes:
+            if call_qual(node, aliases) == "os.replace":
+                yield self.violation(
+                    ctx, node,
+                    "os.replace publishes without the fsync-before/after "
+                    "dance (not durable across power loss); route the "
+                    "publish through util.fs.atomic_write / publish_file / "
+                    "atomic_publish_dir, or baseline a deliberately "
+                    "non-durable replace with a note")

@@ -14,6 +14,8 @@ import shutil
 
 import numpy as np
 
+from ..util.fs import atomic_write
+
 
 class BlobStore:
     """upload/download/list over a bucket-like namespace."""
@@ -63,10 +65,10 @@ class LocalBlobStore(BlobStore):
     def upload_bytes(self, data, key):
         dst = self._path(key)
         os.makedirs(os.path.dirname(dst), exist_ok=True)
-        tmp = dst + ".tmp"
-        with open(tmp, "wb") as f:
-            f.write(data)
-        os.replace(tmp, dst)
+        # durable publish (fsync + replace + dir fsync): an object store
+        # upload either exists completely or not at all, even across a
+        # crash — the S3 semantics this local backend stands in for
+        atomic_write(dst, data)
         return key
 
     def download(self, key, local_path):

@@ -18,6 +18,7 @@ import urllib.request
 import zipfile
 
 from ...resilience.policy import RetryPolicy
+from ...util.fs import publish_file
 
 DEFAULT_CACHE = os.path.join(os.path.expanduser("~"), ".deeplearning4j_tpu",
                              "data")
@@ -52,7 +53,9 @@ def download_file(url, dest, md5=None, max_tries=3, backoff_s=1.0,
                 shutil.copyfileobj(r, f)
             if md5 is not None and _md5(tmp) != md5:
                 raise IOError(f"checksum mismatch for {url}")
-            os.replace(tmp, dest)
+            # durable publish: a crash right after the rename must not leave
+            # a zero-length cache entry that later skips the re-download
+            publish_file(tmp, dest)
             return dest
         except Exception:
             if os.path.exists(tmp):

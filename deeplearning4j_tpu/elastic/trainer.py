@@ -168,6 +168,10 @@ class ElasticTrainer(FaultTolerantTrainer):
         from ..parallel.sharding import ShardedTrainer, make_mesh
         if len(alive) < self.min_replicas:
             path = self.checkpoint()
+            # durably on disk before the raise; a parked writer error is
+            # counted+logged, never allowed to mask ElasticImpossible (the
+            # exception supervisors catch for clean halt-and-requeue)
+            self.drain_checkpoints(raise_errors=False)
             raise ElasticImpossible(
                 f"{len(alive)} alive replicas < min_replicas="
                 f"{self.min_replicas}; checkpointed at {path}")

@@ -28,6 +28,25 @@ and URL substring and injects one failure mode:
                    membership view or ReplicaLauncher; the HTTP interceptor
                    ignores these rules entirely.
 
+Disk faults — the failure class that actually kills long training runs —
+inject through the SECOND choke point, `util.fs`'s write seam (the durable
+checkpoint writer routes every byte through it; graftlint GL013 keeps
+publishers from bypassing it). They match on a *path* substring and are
+invisible to the HTTP interceptor:
+
+- ``torn_write``  — the on-disk file keeps only the first half of the
+                    written bytes (what a crash mid-write / lying fsync
+                    leaves behind); manifest verification catches it at
+                    restore via the byte-size mismatch.
+- ``bitflip``     — one bit flips in the middle byte (media corruption /
+                    bit rot); same size, so only the restore-time sha256
+                    check can catch it.
+- ``enospc``      — `OSError(ENOSPC)` raised from the write (disk full):
+                    the checkpoint writer must leave training running and
+                    the previously published checkpoint intact.
+- ``slow_disk``   — advance the injected clock by `latency_s` per write
+                    (the 30-second NFS stall, without the wait).
+
 Rules fire deterministically: `after` skips the first N matches, `count`
 bounds total injections, `probability` draws from the plan's seeded RNG.
 Rules are JSON-round-trippable (`FaultPlan.to_json/from_json` — the shape is
@@ -42,12 +61,15 @@ documented in README "Resilience & chaos testing") and can be toggled live
 """
 from __future__ import annotations
 
+import errno
 import random
 import threading
 
 from .policy import advance_aware_sleep
 
-KINDS = ("latency", "error", "reset", "wedge", "unhealthy", "preempt")
+DISK_KINDS = ("torn_write", "bitflip", "enospc", "slow_disk")
+KINDS = ("latency", "error", "reset", "wedge", "unhealthy",
+         "preempt") + DISK_KINDS
 
 _UNHEALTHY_BODY = {"status": "unhealthy", "health": "unhealthy",
                    "components": {"chaos": {"status": "unhealthy",
@@ -89,11 +111,18 @@ class FaultRule:
         self.revived = False
 
     def matches(self, method, url) -> bool:
-        if not self.active or self.kind == "preempt":
-            return False         # preempt is step-scripted, never HTTP-matched
+        if not self.active or self.kind == "preempt" \
+                or self.kind in DISK_KINDS:
+            # preempt is step-scripted and disk kinds are path-matched
+            # through the util.fs seam; neither ever fires on HTTP traffic
+            return False
         if self.method is not None and method != self.method:
             return False
         return self.match in url
+
+    def matches_path(self, path) -> bool:
+        """Disk-kind matcher for the util.fs write seam."""
+        return self.active and self.kind in DISK_KINDS and self.match in path
 
     # -- declarative round-trip ---------------------------------------------
     def to_dict(self):
@@ -110,7 +139,7 @@ class FaultRule:
             d["method"] = self.method
         if self.kind == "error":
             d["status"] = self.status
-        if self.kind == "latency":
+        if self.kind in ("latency", "slow_disk"):
             d["latency_s"] = self.latency_s
         if self.after:
             d["after"] = self.after
@@ -142,21 +171,25 @@ class FaultPlan:
         self._rng = random.Random(seed)
         self._lock = threading.Lock()
         self._prev = None
+        self._prev_fs = None
         self._installed = False
 
     # -- lifecycle ------------------------------------------------------------
     def install(self):
-        from ..util import http
+        from ..util import fs, http
         if not self._installed:
             self._prev = http.set_fault_injector(self.intercept)
+            self._prev_fs = fs.set_fs_fault_injector(self.intercept_fs)
             self._installed = True
         return self
 
     def uninstall(self):
-        from ..util import http
+        from ..util import fs, http
         if self._installed:
             http.set_fault_injector(self._prev)
+            fs.set_fs_fault_injector(self._prev_fs)
             self._prev = None
+            self._prev_fs = None
             self._installed = False
         return self
 
@@ -290,3 +323,38 @@ class FaultPlan:
         self._advance(timeout or 0.0)
         raise TimeoutError(f"chaos: wedged socket ({terminal.name}), "
                            f"timed out after {timeout}s")
+
+    def intercept_fs(self, op, path, data=None):
+        """util.fs's injector protocol: called with the bytes about to hit
+        disk; may raise the injected OSError, return corrupted bytes (the
+        on-disk file then disagrees with the in-memory digests the writer
+        recorded in the manifest — exactly what real torn writes / bit rot
+        look like at restore time), or advance the injected clock. Rule
+        selection under the plan lock; the slow_disk time cost paid
+        outside it, like the HTTP interceptor."""
+        delay, corruptions, fail = 0.0, [], None
+        with self._lock:
+            for rule in self.rules:
+                if not rule.matches_path(path) or not self._fire(rule):
+                    continue
+                if rule.kind == "slow_disk":
+                    delay += rule.latency_s   # non-terminal: keep matching
+                elif rule.kind == "enospc":
+                    fail = rule
+                    break
+                else:
+                    corruptions.append(rule)
+        if delay > 0.0:
+            self._advance(delay)
+        if fail is not None:
+            raise OSError(errno.ENOSPC,
+                          f"chaos: injected ENOSPC ({fail.name})", path)
+        for rule in corruptions:
+            if not data:
+                continue              # nothing written yet -> nothing to tear
+            if rule.kind == "torn_write":
+                data = data[:len(data) // 2]
+            elif rule.kind == "bitflip":
+                i = len(data) // 2
+                data = data[:i] + bytes([data[i] ^ 0x40]) + data[i + 1:]
+        return data

@@ -1,0 +1,262 @@
+"""Durable filesystem primitives: fsync'd atomic publish + dir manifests.
+
+`os.replace` alone is atomic in the *namespace* but not durable: POSIX only
+promises the rename survives a crash if the file's data was fsync'd before
+the rename and the parent directory's entry after it. Without both, a power
+loss can publish a name that points at zero-length or stale data — the
+crash-after-replace bug that turns "the newest checkpoint" into a torn zip.
+This module is the one place that does the fsync dance correctly
+(graftlint GL013 `non-durable-publish` keeps bare `os.replace` publishers
+from growing back elsewhere):
+
+- ``atomic_write(path, data)``      — bytes -> temp file (same dir) ->
+  fsync -> `os.replace` -> fsync(parent dir).
+- ``publish_file(tmp, final)``      — same dance for a temp file the caller
+  already streamed to (downloads).
+- ``atomic_publish_dir(tmp, final)``— fsync every file and directory under
+  `tmp`, `os.replace` the whole dir into place, fsync the parent — the
+  checkpoint-directory publish.
+- ``write_manifest`` / ``read_manifest`` / ``verify_manifest`` — a
+  `MANIFEST.json` written *last* (per-file sha256 + byte sizes + caller
+  metadata); a directory artifact without a valid manifest is by
+  definition incomplete, and restore-time hash verification is the ONLY
+  honest torn-write detector (write-time read-back is served from the page
+  cache, which happily returns the bytes the crash will never persist).
+
+Disk-fault seam: every byte written through ``write_bytes`` (and so through
+``atomic_write``/``write_manifest``) passes the installed fault injector
+first — `resilience.chaos.FaultPlan` installs its `torn_write` / `bitflip`
+/ `enospc` / `slow_disk` rules here, so checkpoint chaos tests corrupt
+exactly the file they script, deterministically, with zero monkeypatching.
+
+Stdlib-only on purpose: `analysis/` (the jax-free graftlint entry) and
+`tools/ckpt_doctor.py` import this module without paying the framework
+import.
+"""
+from __future__ import annotations
+
+import hashlib
+import json
+import os
+import tempfile
+
+MANIFEST_NAME = "MANIFEST.json"
+MANIFEST_VERSION = 1
+
+# ---------------------------------------------------------------------------
+# disk-fault seam (resilience.chaos installs here; None in production)
+# ---------------------------------------------------------------------------
+
+_fault_injector = None
+
+
+def set_fs_fault_injector(fn):
+    """Install `fn(op, path, data) -> data` as the write-path interceptor
+    (may raise OSError, return corrupted bytes, or advance the injected
+    clock); returns the previous injector so plans can nest/uninstall."""
+    global _fault_injector
+    prev = _fault_injector
+    _fault_injector = fn
+    return prev
+
+
+def _inject(op, path, data=None):
+    fn = _fault_injector
+    if fn is None:
+        return data
+    return fn(op, path, data)
+
+
+# ---------------------------------------------------------------------------
+# fsync + atomic publish
+# ---------------------------------------------------------------------------
+
+def fsync_file(path):
+    fd = os.open(path, os.O_RDONLY)
+    try:
+        os.fsync(fd)
+    finally:
+        os.close(fd)
+
+
+def fsync_dir(path):
+    """fsync a directory: makes the entries (renames, creates) durable."""
+    fd = os.open(path, os.O_RDONLY)
+    try:
+        os.fsync(fd)
+    finally:
+        os.close(fd)
+
+
+def write_bytes(path, data, fsync=True):
+    """Write `data` (bytes or str) to `path` through the fault seam, then
+    flush+fsync. NOT atomic — callers publishing an artifact want
+    `atomic_write` (single file) or tmp-dir + `atomic_publish_dir`."""
+    if isinstance(data, str):
+        data = data.encode("utf-8")
+    path = os.fspath(path)
+    data = _inject("write", path, data)
+    with open(path, "wb") as f:
+        f.write(data)
+        if fsync:
+            f.flush()
+            os.fsync(f.fileno())
+    return path
+
+
+def atomic_write(path, data, fsync=True):
+    """Durably publish `data` at `path`: temp file in the same directory
+    (same filesystem, so the replace stays atomic), fsync, `os.replace`,
+    fsync the parent directory. A reader sees the old content or the new
+    content, never a mix, even across power loss."""
+    path = os.fspath(path)
+    parent = os.path.dirname(os.path.abspath(path))
+    fd, tmp = tempfile.mkstemp(prefix=os.path.basename(path) + ".",
+                               suffix=".tmp", dir=parent)
+    os.close(fd)
+    try:
+        write_bytes(tmp, data, fsync=fsync)
+        os.replace(tmp, path)
+        if fsync:
+            fsync_dir(parent)
+    except BaseException:
+        try:
+            os.unlink(tmp)
+        except OSError:
+            pass
+        raise
+    return path
+
+
+def publish_file(tmp, final, fsync=True):
+    """Durably publish an already-written temp file: fsync it, `os.replace`
+    into place, fsync the parent directory (the streamed-download case,
+    where the caller wrote `tmp` incrementally)."""
+    tmp, final = os.fspath(tmp), os.fspath(final)
+    if fsync:
+        fsync_file(tmp)
+    os.replace(tmp, final)
+    if fsync:
+        fsync_dir(os.path.dirname(os.path.abspath(final)))
+    return final
+
+
+def atomic_publish_dir(tmp_dir, final_dir, fsync=True):
+    """Durably publish a fully-written directory: fsync every file and every
+    directory under `tmp_dir` (bottom-up is unnecessary — fsync order
+    within the tree doesn't matter as long as ALL of it precedes the
+    rename), `os.replace` the directory into place, fsync the parent."""
+    tmp_dir, final_dir = os.fspath(tmp_dir), os.fspath(final_dir)
+    if fsync:
+        for dirpath, _dirnames, filenames in os.walk(tmp_dir):
+            for name in filenames:
+                fsync_file(os.path.join(dirpath, name))
+            fsync_dir(dirpath)
+    os.replace(tmp_dir, final_dir)
+    if fsync:
+        fsync_dir(os.path.dirname(os.path.abspath(final_dir)))
+    return final_dir
+
+
+def quarantine_dir(root, name, prefix="corrupt-"):
+    """Move `root/name` aside as `root/<prefix><name>` (suffixing `.2`,
+    `.3`... on collision) and return the new basename — the one rename-aside
+    scheme shared by the trainer's restore walk and tools/ckpt_doctor.py."""
+    src = os.path.join(root, name)
+    dst = os.path.join(root, f"{prefix}{name}")
+    n = 1
+    while os.path.exists(dst):
+        n += 1
+        dst = os.path.join(root, f"{prefix}{name}.{n}")
+    os.rename(src, dst)
+    return os.path.basename(dst)
+
+
+# ---------------------------------------------------------------------------
+# manifests
+# ---------------------------------------------------------------------------
+
+def sha256_bytes(data) -> str:
+    if isinstance(data, str):
+        data = data.encode("utf-8")
+    return hashlib.sha256(data).hexdigest()
+
+
+def sha256_file(path, chunk=1 << 20) -> str:
+    h = hashlib.sha256()
+    with open(path, "rb") as f:
+        while True:
+            block = f.read(chunk)
+            if not block:
+                break
+            h.update(block)
+    return h.hexdigest()
+
+
+def _iter_rel_files(dirpath):
+    for root, _dirs, files in os.walk(dirpath):
+        for name in sorted(files):
+            full = os.path.join(root, name)
+            rel = os.path.relpath(full, dirpath).replace(os.sep, "/")
+            if rel != MANIFEST_NAME:
+                yield rel, full
+
+
+def write_manifest(dirpath, files=None, fsync=True, **meta):
+    """Write `dirpath/MANIFEST.json` LAST (after every data file): per-file
+    sha256 + byte sizes plus caller metadata (step, wall time, topology...).
+
+    `files`: {relname: (sha256_hex, n_bytes)} computed from the IN-MEMORY
+    content the caller just wrote — the manifest then records what the
+    writer *intended*, so a torn/bit-flipped on-disk file fails
+    verification later. When None, the directory's current contents are
+    hashed by read-back (third-party serializers like orbax, or an
+    operator re-blessing a repaired dir via ckpt_doctor)."""
+    if files is None:
+        files = {rel: (sha256_file(full), os.path.getsize(full))
+                 for rel, full in _iter_rel_files(dirpath)}
+    doc = dict(meta)
+    doc["version"] = MANIFEST_VERSION
+    doc["files"] = {rel: {"sha256": digest, "bytes": int(size)}
+                    for rel, (digest, size) in sorted(files.items())}
+    atomic_write(os.path.join(dirpath, MANIFEST_NAME),
+                 json.dumps(doc, indent=1, sort_keys=True) + "\n",
+                 fsync=fsync)
+    return doc
+
+
+def read_manifest(dirpath):
+    """Parse `dirpath/MANIFEST.json`; raises (OSError/ValueError) when
+    missing or unreadable — the caller decides what incomplete means."""
+    with open(os.path.join(dirpath, MANIFEST_NAME), encoding="utf-8") as f:
+        return json.load(f)
+
+
+def verify_manifest(dirpath, hash=True):
+    """(ok, errors) for a manifested directory: the manifest must exist and
+    parse, and every listed file must exist with the recorded byte size
+    (and, with `hash=True`, the recorded sha256). Extra files NOT in the
+    manifest are ignored — strays don't corrupt the listed artifact."""
+    errors = []
+    try:
+        doc = read_manifest(dirpath)
+    except OSError as e:
+        return False, [f"no readable {MANIFEST_NAME}: {e}"]
+    except ValueError as e:
+        return False, [f"{MANIFEST_NAME} is not valid JSON: {e}"]
+    entries = doc.get("files")
+    if not isinstance(entries, dict) or not entries:
+        return False, [f"{MANIFEST_NAME} lists no files"]
+    for rel, meta in sorted(entries.items()):
+        full = os.path.join(dirpath, rel.replace("/", os.sep))
+        if not os.path.isfile(full):
+            errors.append(f"{rel}: missing")
+            continue
+        size = os.path.getsize(full)
+        if size != meta.get("bytes"):
+            errors.append(f"{rel}: size {size} != manifest {meta.get('bytes')}"
+                          f" (torn write)")
+            continue
+        if hash and sha256_file(full) != meta.get("sha256"):
+            errors.append(f"{rel}: sha256 mismatch (corrupt content)")
+    return (not errors), errors

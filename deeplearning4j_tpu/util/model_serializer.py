@@ -57,6 +57,18 @@ def _npz_bytes_to_flat(data):
     return {k.replace("__SLASH__", "/"): npz[k] for k in npz.files}
 
 
+def _writestr(zf, name, data):
+    """Deterministic zip entry: fixed DOS timestamp (zipfile.writestr with a
+    bare name stamps wall time, so the same model state would serialize to
+    different bytes second over second). Identical state -> identical zip
+    bytes is what makes async-vs-sync checkpoints comparable and manifest
+    digests stable."""
+    info = zipfile.ZipInfo(name, date_time=(1980, 1, 1, 0, 0, 0))
+    info.compress_type = zipfile.ZIP_DEFLATED
+    info.external_attr = 0o600 << 16
+    zf.writestr(info, data)
+
+
 def _rebuild_like(template, flat, prefix=""):
     """Rebuild a pytree in the shape of `template` from the flat name->array map."""
     if isinstance(template, dict):
@@ -77,21 +89,30 @@ class ModelSerializer:
         """`normalizer` (an etl.DataNormalizer fitted on the training data)
         rides in the zip as `normalizer.json`, so serving applies the
         identical preprocessing (reference: ModelSerializer
-        .addNormalizerToModel / restoreNormalizerFromFile)."""
+        .addNormalizerToModel / restoreNormalizerFromFile).
+
+        A filesystem `path` is published DURABLY (util.fs.atomic_write:
+        temp + fsync + os.replace + dir fsync — a crash mid-save leaves the
+        previous model, never a torn zip); a file object is written
+        directly (the async checkpoint writer serializes to memory first).
+        `model` may also be a host snapshot proxy carrying a `model_class`
+        attribute instead of being a live network (train.fault_tolerance)."""
         from ..nn.multilayer.network import MultiLayerNetwork
         from ..nn.graph.graph import ComputationGraph
-        is_graph = isinstance(model, ComputationGraph)
-        with zipfile.ZipFile(path, "w", zipfile.ZIP_DEFLATED) as zf:
-            zf.writestr(FORMAT_ENTRY, json.dumps({
+        is_graph = isinstance(model, ComputationGraph) or \
+            getattr(model, "model_class", None) == "ComputationGraph"
+        target = path if hasattr(path, "write") else io.BytesIO()
+        with zipfile.ZipFile(target, "w", zipfile.ZIP_DEFLATED) as zf:
+            _writestr(zf, FORMAT_ENTRY, json.dumps({
                 "model_class": "ComputationGraph" if is_graph else "MultiLayerNetwork",
                 "dtype": str(model.conf.dtype),
                 "framework": "deeplearning4j-tpu",
                 "version": 1,
             }))
-            zf.writestr(CONFIG_ENTRY, model.conf.to_json())
-            zf.writestr(COEFFICIENTS_ENTRY, _tree_to_npz_bytes(model.params))
+            _writestr(zf, CONFIG_ENTRY, model.conf.to_json())
+            _writestr(zf, COEFFICIENTS_ENTRY, _tree_to_npz_bytes(model.params))
             if model.states:
-                zf.writestr(STATE_ENTRY, _tree_to_npz_bytes(model.states))
+                _writestr(zf, STATE_ENTRY, _tree_to_npz_bytes(model.states))
             if save_updater and model.opt_state is not None:
                 # optax states are namedtuple pytrees: store leaves positionally.
                 # ZeRO-sharded updater state (parallel/zero.py) is converted
@@ -106,39 +127,33 @@ class ModelSerializer:
                 arrs = {f"leaf{i}": np.asarray(l) for i, l in enumerate(leaves)}
                 buf = io.BytesIO()
                 np.savez(buf, **arrs)
-                zf.writestr(UPDATER_ENTRY, buf.getvalue())
+                _writestr(zf, UPDATER_ENTRY, buf.getvalue())
             if normalizer is not None:
-                zf.writestr(NORMALIZER_ENTRY, normalizer.to_json())
+                _writestr(zf, NORMALIZER_ENTRY, normalizer.to_json())
+        if target is not path:
+            from .fs import atomic_write
+            atomic_write(path, target.getvalue())
         return path
 
     @staticmethod
     def add_normalizer(path, normalizer):
         """Append/replace the normalizer entry of an existing model zip
         (reference: ModelSerializer.addNormalizerToModel). zipfile append
-        mode would duplicate the entry name, so rewrite the archive — into a
-        sibling temp file first, then atomically replace: rewriting in place
-        would truncate the zip before the coefficients are re-written, and a
-        crash mid-rewrite would destroy the trained model."""
-        import os
-        import tempfile
+        mode would duplicate the entry name, so the archive is rebuilt in
+        memory and published through util.fs.atomic_write — rewriting in
+        place would truncate the zip before the coefficients are
+        re-written, and a non-durable replace could still destroy the
+        trained model across a power loss."""
+        from .fs import atomic_write
         with zipfile.ZipFile(path, "r") as zf:
             entries = [(n, zf.read(n)) for n in zf.namelist()
                        if n != NORMALIZER_ENTRY]
-        fd, tmp = tempfile.mkstemp(
-            suffix=".zip.tmp", dir=os.path.dirname(os.path.abspath(path)))
-        try:
-            with os.fdopen(fd, "wb") as fh, \
-                    zipfile.ZipFile(fh, "w", zipfile.ZIP_DEFLATED) as zf:
-                for n, data in entries:
-                    zf.writestr(n, data)
-                zf.writestr(NORMALIZER_ENTRY, normalizer.to_json())
-            os.replace(tmp, path)
-        except BaseException:
-            try:
-                os.unlink(tmp)
-            except OSError:
-                pass
-            raise
+        buf = io.BytesIO()
+        with zipfile.ZipFile(buf, "w", zipfile.ZIP_DEFLATED) as zf:
+            for n, data in entries:
+                _writestr(zf, n, data)
+            _writestr(zf, NORMALIZER_ENTRY, normalizer.to_json())
+        atomic_write(path, buf.getvalue())
         return path
 
     @staticmethod

@@ -422,6 +422,66 @@ def test_fault_plan_json_round_trip():
         FaultRule("explode", match="x")
 
 
+def test_disk_fault_rules_json_round_trip():
+    """The four disk kinds JSON-round-trip like the HTTP rules, are
+    invisible to the HTTP matcher, and path-match through matches_path."""
+    plan = FaultPlan([
+        FaultRule("torn_write", match="model.zip", after=2, count=1,
+                  name="tear"),
+        FaultRule("bitflip", match="coefficients", probability=0.5),
+        FaultRule("enospc", match="ckpt-", name="disk-full", active=False),
+        FaultRule("slow_disk", match="", latency_s=0.25),
+    ], seed=7)
+    doc = plan.to_json()
+    again = FaultPlan.from_json(doc, seed=7)
+    assert again.to_json() == doc
+    assert [r.kind for r in again.rules] == ["torn_write", "bitflip",
+                                             "enospc", "slow_disk"]
+    assert again.rules[3].latency_s == 0.25
+    for r in again.rules:
+        assert not r.matches("POST", "http://h/model.zip")  # never HTTP
+    assert again.rules[0].matches_path("/ck/tmp-1/model.zip")
+    assert not again.rules[0].matches_path("/ck/tmp-1/state.json")
+    assert not again.rules[2].matches_path("/ck/ckpt-1/x")  # inactive
+
+
+def test_disk_faults_fire_through_the_fs_seam(tmp_path, manual_clock):
+    """FaultPlan.install() hooks util.fs: torn_write halves the on-disk
+    bytes, bitflip flips one bit (size preserved), enospc raises
+    OSError(ENOSPC), slow_disk advances the injected clock — all
+    deterministic, counted in plan.injected()."""
+    import errno
+    from deeplearning4j_tpu.util import fs
+
+    data = bytes(range(256)) * 4
+    plan = FaultPlan([
+        FaultRule("slow_disk", match="slow", latency_s=1.5),
+        FaultRule("torn_write", match="torn.bin", name="tear"),
+        FaultRule("bitflip", match="flip.bin", name="flip"),
+        FaultRule("enospc", match="full.bin", name="full"),
+    ], seed=3)
+    with plan:
+        t0 = manual_clock.monotonic()
+        fs.write_bytes(tmp_path / "slow-a.bin", data)
+        assert manual_clock.monotonic() - t0 == pytest.approx(1.5)
+        fs.write_bytes(tmp_path / "torn.bin", data)
+        fs.write_bytes(tmp_path / "flip.bin", data)
+        with pytest.raises(OSError) as ei:
+            fs.write_bytes(tmp_path / "full.bin", data)
+        assert ei.value.errno == errno.ENOSPC
+    assert (tmp_path / "torn.bin").stat().st_size == len(data) // 2
+    flipped = (tmp_path / "flip.bin").read_bytes()
+    assert len(flipped) == len(data)
+    diff = [i for i in range(len(data)) if flipped[i] != data[i]]
+    assert diff == [len(data) // 2]
+    assert not (tmp_path / "full.bin").exists()
+    assert plan.injected() == {"slow_disk": 1, "tear": 1, "flip": 1,
+                               "full": 1}
+    # uninstalled: writes pass through clean
+    fs.write_bytes(tmp_path / "torn.bin", data)
+    assert (tmp_path / "torn.bin").stat().st_size == len(data)
+
+
 def test_fault_rule_after_count_probability_and_method(manual_clock):
     plan = FaultPlan([FaultRule("error", match="/p", after=1, count=2)],
                      seed=0)

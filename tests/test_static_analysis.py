@@ -5,6 +5,7 @@ the baseline round-trips through --baseline-update, and the whole-repo gate
 import json
 import subprocess
 import sys
+import textwrap
 import time
 from pathlib import Path
 
@@ -116,6 +117,12 @@ class Pool:
             t = threading.Thread(target=self.work, daemon=True)
             t.start()
 """, [6]),
+    "GL013": ("""\
+import os
+
+def publish(tmp, final):
+    os.replace(tmp, final)
+""", [4]),
 }
 
 
@@ -748,6 +755,42 @@ def test_gl010_repo_hot_modules_donate_or_are_baselined():
          "deeplearning4j_tpu/nn/multilayer/network.py"]
 
 
+def test_gl013_edges():
+    """util/fs.py (the one durable publisher) is allowed; os.rename and
+    shutil.move are out of scope; aliased `from os import replace`
+    resolves."""
+    src = SEEDS["GL013"][0]
+    assert lint(src, rel_path="deeplearning4j_tpu/util/fs.py") == []
+    other = textwrap.dedent("""\
+    import os
+    import shutil
+
+    def shuffle(a, b):
+        os.rename(a, b)
+        shutil.move(a, b)
+    """)
+    assert lint(other, rules=["GL013"]) == []
+    aliased = textwrap.dedent("""\
+    from os import replace
+
+    def publish(tmp, final):
+        replace(tmp, final)
+    """)
+    [v] = lint(aliased, rules=["GL013"])
+    assert v.rule == "GL013" and v.line == 4
+
+
+def test_gl013_repo_publishers_are_durable():
+    """Satellite gate: every os.replace publisher in the package + tools
+    goes through util.fs (checkpoint writer, ModelSerializer, blob store,
+    baseline save, download cache) — zero GL013 findings, zero baselined
+    remainders."""
+    report = Analyzer(rules=[get_rule("GL013")], root=str(REPO)).analyze_paths(
+        ["deeplearning4j_tpu", "tools"])
+    assert report.errors == []
+    assert report.violations == [], [str(v) for v in report.violations]
+
+
 # ---------------------------------------------------------------- baseline
 
 def test_baseline_round_trip_via_cli(tmp_path):
@@ -878,7 +921,7 @@ def test_cli_rule_subset_and_list_rules():
         assert rule.id in proc.stdout and rule.rationale
     assert [r.id for r in all_rules()] == \
         ["GL001", "GL002", "GL003", "GL004", "GL005", "GL006", "GL007",
-         "GL008", "GL009", "GL010", "GL011", "GL012"]
+         "GL008", "GL009", "GL010", "GL011", "GL012", "GL013"]
 
 
 def test_repo_gate_is_clean_and_fast():

@@ -1,0 +1,135 @@
+#!/usr/bin/env python
+"""Checkpoint first-aid CLI: inspect, verify, quarantine, and re-bless the
+checkpoint directories `train.FaultTolerantTrainer` writes.
+
+    python tools/ckpt_doctor.py list <ckpt-root>
+    python tools/ckpt_doctor.py verify <ckpt-root> [<name>]
+    python tools/ckpt_doctor.py quarantine <ckpt-root> <name>
+    python tools/ckpt_doctor.py manifest <ckpt-dir>
+
+- `list`       — every ckpt-*/halt-*/corrupt-* entry with step, wall time,
+                 format, and verification status.
+- `verify`     — full manifest verification (sizes + sha256) of one
+                 checkpoint, or of every ckpt-* when no name is given;
+                 exit 1 if anything fails (the CI / cron spelling).
+- `quarantine` — move a checkpoint aside as `corrupt-<name>` so the
+                 trainer's restore walk skips it (what the trainer does
+                 automatically when verification fails; this is the manual
+                 override for a checkpoint an operator distrusts).
+- `manifest`   — (re)generate MANIFEST.json from a directory's CURRENT
+                 contents, hashing by read-back. For legacy pre-manifest
+                 checkpoints or a dir an operator repaired by hand: running
+                 it asserts "I trust these bytes as of now".
+
+Imports only the stdlib-only `util.fs` (via the same parent-package stub
+trick as graftlint_entry), so the doctor starts in milliseconds on hosts
+without jax — exactly the hosts where you're doing disk forensics.
+"""
+from __future__ import annotations
+
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+import graftlint_entry  # noqa: E402
+
+
+def _fs():
+    graftlint_entry._stub_parent_package()
+    from deeplearning4j_tpu.util import fs
+    return fs
+
+
+PREFIXES = ("ckpt-", "halt-", "corrupt-")
+
+
+def _entries(root):
+    out = []
+    for name in sorted(os.listdir(root)):
+        if name.startswith(PREFIXES) and \
+                os.path.isdir(os.path.join(root, name)):
+            out.append(name)
+    return out
+
+
+def _describe(fs, root, name):
+    path = os.path.join(root, name)
+    try:
+        man = fs.read_manifest(path)
+    except (OSError, ValueError) as e:
+        return {"name": name, "manifest": f"unreadable: {e}", "ok": False}
+    ok, errors = fs.verify_manifest(path)
+    return {"name": name, "ok": ok, "step": man.get("step"),
+            "format": man.get("format"),
+            "wall_time_s": man.get("wall_time_s"),
+            "files": len(man.get("files", {})),
+            "errors": errors}
+
+
+def cmd_list(root):
+    fs = _fs()
+    for name in _entries(root):
+        d = _describe(fs, root, name)
+        status = "OK " if d["ok"] else "BAD"
+        print(f"{status} {name}  step={d.get('step')} "
+              f"format={d.get('format')} files={d.get('files')}"
+              + ("" if d["ok"] else f"  {d.get('errors') or d['manifest']}"))
+    return 0
+
+
+def cmd_verify(root, name=None):
+    fs = _fs()
+    names = [name] if name else \
+        [n for n in _entries(root) if n.startswith("ckpt-")]
+    if not names:
+        print(f"no checkpoints under {root}", file=sys.stderr)
+        return 1
+    bad = 0
+    for n in names:
+        d = _describe(fs, root, n)
+        print(json.dumps(d))
+        bad += 0 if d["ok"] else 1
+    return 1 if bad else 0
+
+
+def cmd_quarantine(root, name):
+    fs = _fs()
+    src = os.path.join(root, name)
+    if not os.path.isdir(src):
+        print(f"no such checkpoint: {src}", file=sys.stderr)
+        return 1
+    dst = fs.quarantine_dir(root, name)   # the trainer's rename-aside scheme
+    print(f"quarantined {name} -> {dst}")
+    return 0
+
+
+def cmd_manifest(ckpt_dir):
+    fs = _fs()
+    if not os.path.isdir(ckpt_dir):
+        print(f"no such directory: {ckpt_dir}", file=sys.stderr)
+        return 1
+    doc = fs.write_manifest(ckpt_dir)  # read-back hashing: trust-as-of-now
+    print(f"wrote {fs.MANIFEST_NAME} covering {len(doc['files'])} files")
+    return 0
+
+
+def main(argv=None):
+    argv = list(sys.argv[1:] if argv is None else argv)
+    cmds = {"list": (cmd_list, 1, 1), "verify": (cmd_verify, 1, 2),
+            "quarantine": (cmd_quarantine, 2, 2),
+            "manifest": (cmd_manifest, 1, 1)}
+    if not argv or argv[0] not in cmds:
+        print(__doc__.split("\n\n")[1], file=sys.stderr)
+        return 2
+    fn, lo, hi = cmds[argv[0]]
+    args = argv[1:]
+    if not (lo <= len(args) <= hi):
+        print(f"usage error: {argv[0]} takes {lo}..{hi} args",
+              file=sys.stderr)
+        return 2
+    return fn(*args)
+
+
+if __name__ == "__main__":
+    sys.exit(main())
