@@ -1,4 +1,4 @@
-"""GL001–GL013: the rule catalog (see RULES.md for the bug-history rationale).
+"""GL001–GL014: the rule catalog (see RULES.md for the bug-history rationale).
 
 Each rule is intra-file AST analysis with light import resolution: aliases
 from ``import x as y`` / ``from m import n as y`` are resolved so
@@ -1002,3 +1002,105 @@ class NonDurablePublishRule(Rule):
                     "publish through util.fs.atomic_write / publish_file / "
                     "atomic_publish_dir, or baseline a deliberately "
                     "non-durable replace with a note")
+
+
+# ---------------------------------------------------------------------------
+# GL014 — quant-silent-widening
+# ---------------------------------------------------------------------------
+
+@register
+class QuantSilentWideningRule(Rule):
+    """float32/float64 widening of quantized moment/weight leaves outside
+    the designated quant/dequant modules."""
+
+    id = "GL014"
+    name = "quant-silent-widening"
+    rationale = (
+        "The bytes diet (ROADMAP item 3) only works while the quantized "
+        "leaves STAY narrow: an `astype(np.float32)` / `jnp.float32(...)` "
+        "on moment or weight-quant leaves outside nn/quant.py or "
+        "parallel/zero.py silently re-materializes the f32 bytes the diet "
+        "removed (HBM reads widen again at roofline_util~1.0) AND bypasses "
+        "the codec's exact-round-trip contract — a hand-widened moment "
+        "re-quantizes through a different path and the bitwise re-shard "
+        "guarantees quietly rot. Decode through the codec (MomentCodec."
+        "decode / WeightQuant.dequant), or baseline a deliberate host-side "
+        "widening with a note.")
+
+    # the designated quant/dequant homes: the codecs themselves and the
+    # ZeRO layout that drives them
+    ALLOW = ("nn/quant.py", "parallel/zero.py")
+    # receivers/arguments that look like quantized artifacts — exact
+    # segment tokens only ("quantile"/"quantity" must NOT match)
+    _QUANT_NAME = re.compile(
+        r"(^|_)(q8|q?codes?|q?scales?|quant|quantized|dequant|dequantized"
+        r"|moments?|mu|nu)(_|$)")
+    _WIDE_QUALS = {"numpy.float32", "numpy.float64",
+                   "jax.numpy.float32", "jax.numpy.float64"}
+
+    def check(self, ctx):
+        if ctx.rel_path.endswith(self.ALLOW):
+            return
+        aliases = ctx.aliases
+        for node in ctx.nodes:
+            if not isinstance(node, ast.Call):
+                continue
+            wide, target = self._widening(node, aliases)
+            if wide is None or target is None:
+                continue
+            name = self._leaf_name(target)
+            if name is not None and self._QUANT_NAME.search(name):
+                yield self.violation(
+                    ctx, node,
+                    f"widening `{name}` to {wide} outside the designated "
+                    f"quant modules re-materializes the bytes the diet "
+                    f"removed and bypasses the codec round-trip; decode "
+                    f"via nn.quant (MomentCodec.decode / WeightQuant."
+                    f"dequant), or baseline a deliberate widening with a "
+                    f"note")
+
+    def _widening(self, node, aliases):
+        """(widened-to dtype, the node being widened), or (None, None)."""
+        # x.astype(np.float32) / x.astype(dtype=np.float32) / x.astype("float32")
+        if isinstance(node.func, ast.Attribute) and node.func.attr == "astype":
+            cand = node.args[0] if node.args else next(
+                (kw.value for kw in node.keywords if kw.arg == "dtype"), None)
+            return self._float_dtype(cand, aliases), node.func.value
+        qual = call_qual(node, aliases)
+        # jnp.float32(x) / np.float64(x) constructor-style widening
+        if qual in self._WIDE_QUALS and node.args:
+            return qual, node.args[0]
+        # np.asarray(x, np.float32) / jnp.array(x, dtype=jnp.float32)
+        if qual in ("numpy.asarray", "numpy.array",
+                    "jax.numpy.asarray", "jax.numpy.array") and node.args:
+            cand = node.args[1] if len(node.args) > 1 else next(
+                (kw.value for kw in node.keywords if kw.arg == "dtype"), None)
+            return self._float_dtype(cand, aliases), node.args[0]
+        return None, None
+
+    def _float_dtype(self, node, aliases):
+        if node is None:
+            return None
+        qual = qualname(node, aliases)
+        if qual in self._WIDE_QUALS:
+            return qual
+        if isinstance(node, ast.Constant) and node.value in ("float32",
+                                                             "float64"):
+            return node.value
+        return None
+
+    @staticmethod
+    def _leaf_name(node):
+        """The identifier a widening targets: bare name, attribute tail
+        (self._mu -> "_mu"), or a constant-string subscript key
+        (state["qcodes"] -> "qcodes"). Calls/expressions stay None — the
+        rule only claims what it can name."""
+        if isinstance(node, ast.Name):
+            return node.id
+        if isinstance(node, ast.Attribute):
+            return node.attr
+        if isinstance(node, ast.Subscript) \
+                and isinstance(node.slice, ast.Constant) \
+                and isinstance(node.slice.value, str):
+            return node.slice.value
+        return None

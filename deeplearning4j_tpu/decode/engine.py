@@ -312,6 +312,9 @@ class DecodeEngine:
         C = self.capacity
 
         def step_fn(params, states, cache, ids):
+            # int8 serving weights: decode executables consume the narrow
+            # codes too; the fused dequant is the same one output() traces
+            params = self.model._dequant_params(params)
             lengths = cache["lengths"]
             pos = jnp.clip(lengths, 0, C - 1)
             x0 = self._one_hot(ids[:, None])              # [S, 1, V]
@@ -327,6 +330,7 @@ class DecodeEngine:
 
     def _build_prefill(self, L):
         def prefill_fn(params, states, cache, slot, ids, length):
+            params = self.model._dequant_params(params)
             x0 = self._one_hot(ids[None, :])              # [1, L, V]
             valid = (jnp.arange(L, dtype=jnp.int32)
                      < length).astype(self._dtype)[None]  # [1, L]

@@ -60,7 +60,8 @@ class ElasticTrainer(FaultTolerantTrainer):
 
     def __init__(self, net_factory, checkpoint: CheckpointConfig,
                  devices=None, membership=None, plan=None, rules=None,
-                 min_replicas=1, health=None, monitor=None, logger=None):
+                 min_replicas=1, health=None, monitor=None, logger=None,
+                 moment_dtype=None):
         import jax
         devices = list(devices) if devices is not None else list(jax.devices())
         if not devices:
@@ -73,6 +74,11 @@ class ElasticTrainer(FaultTolerantTrainer):
             MembershipView(sorted(self._device_of))
         self.plan = plan
         self.rules = rules
+        # "bf16"/"q8" store the sharded moments low-bit (nn/quant.py);
+        # re-shards preserve the codec — conversions go old-sharded ->
+        # canonical f32 -> new-sharded, and the q8 codec's exact round-trip
+        # keeps chains bit-stable
+        self.moment_dtype = moment_dtype
         self.min_replicas = int(min_replicas)
         self.reshards = 0
         self.preemption_events = []          # applied kill/revive events
@@ -113,7 +119,8 @@ class ElasticTrainer(FaultTolerantTrainer):
         devs = [self._device_of[n] for n in self._alive]
         mesh = make_mesh(n_data=len(devs), devices=devs)
         return ShardedTrainer(self._net_factory(), mesh=mesh,
-                              rules=self.rules, shard_update=True)
+                              rules=self.rules, shard_update=True,
+                              moment_dtype=self.moment_dtype)
 
     def _probe_detail(self):
         return {"replicas": len(self._alive), "reshards": self.reshards,
@@ -184,7 +191,8 @@ class ElasticTrainer(FaultTolerantTrainer):
             devs = [self._device_of[n] for n in alive]
             mesh = make_mesh(n_data=len(devs), devices=devs)
             self.model = ShardedTrainer(net, mesh=mesh, rules=self.rules,
-                                        shard_update=True)
+                                        shard_update=True,
+                                        moment_dtype=self.moment_dtype)
             self.logger.info("elastic_reshard", replicas_from=old_n,
                              replicas_to=new_n, direction=direction,
                              iteration=self.state["iteration"],

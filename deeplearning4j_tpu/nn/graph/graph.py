@@ -48,6 +48,7 @@ class ComputationGraph(MultiStepTrainable):
         self._rnn_state = {}
         self._ingest = None         # device-side ingest fused into the step
         self._zero = None           # ZeRO-1 sharded update (parallel/zero.py)
+        self._wq = None             # int8 serving weights (nn/quant.py)
 
     @property
     def score_value(self):
@@ -432,6 +433,7 @@ class ComputationGraph(MultiStepTrainable):
     def fit_batch(self, ds):
         if self.params is None:
             self.init()
+        self._check_trainable()     # int8 serving weights can't train
         inputs, labels, masks, lmasks = self._prep_batch(ds)
         self._rng, step_rng = jax.random.split(self._rng)
         from ..conf.configuration import OptimizationAlgorithm
@@ -508,6 +510,9 @@ class ComputationGraph(MultiStepTrainable):
         key = ("output", len(inputs), masked)
         if key not in self._jit_cache:
             def fwd(params, states, xs, mm):
+                # int8 serving weights: codes are the executable's operands;
+                # the traced dequant fuses into the consumers (nn/quant.py)
+                params = self._dequant_params(params)
                 params, xs = self._cast_for_compute(params, xs)
                 masks = None if mm is None else [mm] + [None] * (len(xs) - 1)
                 acts, _, _, _ = self._forward(params, states, xs, train=False,
@@ -520,7 +525,8 @@ class ComputationGraph(MultiStepTrainable):
         return outs[0] if len(outs) == 1 else outs
 
     def feed_forward(self, *inputs, train=False):
-        acts, _, _, _ = self._forward(self.params, self.states,
+        acts, _, _, _ = self._forward(self._dequant_params(self.params),
+                                      self.states,
                                       [jnp.asarray(x) for x in inputs],
                                       train=train, rng=None)
         return acts
@@ -531,8 +537,8 @@ class ComputationGraph(MultiStepTrainable):
             ds = MultiDataSet([ds.features], [ds.labels])
         inputs = [jnp.asarray(f) for f in ds.features]
         labels = [jnp.asarray(l, self._dtype) for l in ds.labels]
-        s, _ = self._loss(self.params, self.states, inputs, labels, train=False,
-                          rng=None)
+        s, _ = self._loss(self._dequant_params(self.params), self.states,
+                          inputs, labels, train=False, rng=None)
         return float(s)
 
     def compute_gradient_and_score(self, inputs, labels, masks=None, label_masks=None):
@@ -555,9 +561,9 @@ class ComputationGraph(MultiStepTrainable):
             inputs = [x[:, None, :] if x.ndim == 2 else x for x in inputs]
         batch = inputs[0].shape[0]
         carries = self._rnn_state or self._zero_carries(batch)
-        acts, _, _, new_carries = self._forward(self.params, self.states, inputs,
-                                                train=False, rng=None,
-                                                initial_carries=carries)
+        acts, _, _, new_carries = self._forward(
+            self._dequant_params(self.params), self.states, inputs,
+            train=False, rng=None, initial_carries=carries)
         self._rnn_state = new_carries
         outs = [acts[o] for o in self.conf.network_outputs]
         if squeeze:

@@ -55,6 +55,7 @@ class MultiLayerNetwork(MultiStepTrainable):
         self._jit_cache = {}
         self._ingest = None         # device-side ingest fused into the step
         self._zero = None           # ZeRO-1 sharded update (parallel/zero.py)
+        self._wq = None             # int8 serving weights (nn/quant.py)
 
     @property
     def score_value(self):
@@ -515,6 +516,7 @@ class MultiLayerNetwork(MultiStepTrainable):
         """One minibatch step — one XLA computation on device."""
         if self.params is None:
             self.init()
+        self._check_trainable()        # int8 serving weights can't train
         tracer = get_tracer()          # no-op spans when tracing is off
         with tracer.span("iteration", iteration=self.iteration_count):
             x, y, mask, lmask = self._prep_batch(ds)
@@ -604,6 +606,10 @@ class MultiLayerNetwork(MultiStepTrainable):
             is_train = bool(train)
 
             def fwd(params, states, xx, mm):
+                # int8 serving weights: the executable's params inputs ARE
+                # the narrow codes; this traced dequant fuses the widening
+                # into the consumers (nn/quant.py)
+                params = self._dequant_params(params)
                 params, xx = self._cast_for_compute(
                     params, xx, keep_f32=(str(len(self.layers) - 1),))
                 out, _, _, _, _ = self._forward(params, states, xx,
@@ -619,7 +625,8 @@ class MultiLayerNetwork(MultiStepTrainable):
     def feed_forward(self, x, train=False):
         """Per-layer activations list (reference: feedForward)."""
         x = jnp.asarray(x)
-        _, _, _, _, acts = self._forward(self.params, self.states, x, train=train,
+        _, _, _, _, acts = self._forward(self._dequant_params(self.params),
+                                         self.states, x, train=train,
                                          rng=None, collect=True)
         return acts
 
@@ -627,7 +634,8 @@ class MultiLayerNetwork(MultiStepTrainable):
         """(reference: feedForwardToLayer :692) — activations up to and
         including layer_idx."""
         x = jnp.asarray(x)
-        out, _, _, _, _ = self._forward(self.params, self.states, x, train=train,
+        out, _, _, _, _ = self._forward(self._dequant_params(self.params),
+                                        self.states, x, train=train,
                                         rng=None, to_layer=layer_idx + 1)
         return out
 
@@ -639,7 +647,8 @@ class MultiLayerNetwork(MultiStepTrainable):
             x, y = ds_or_x.features, ds_or_x.labels
             mask = ds_or_x.features_mask
             lmask = ds_or_x.labels_mask
-        s, _ = self._loss(self.params, self.states, jnp.asarray(x), jnp.asarray(y),
+        s, _ = self._loss(self._dequant_params(self.params), self.states,
+                          jnp.asarray(x), jnp.asarray(y),
                           train=train, rng=None,
                           mask=None if mask is None else jnp.asarray(mask),
                           label_mask=None if lmask is None else jnp.asarray(lmask))
@@ -666,8 +675,8 @@ class MultiLayerNetwork(MultiStepTrainable):
             x = x[:, None, :]
         carries = self._rnn_state or self._zero_carries(x.shape[0], self._dtype)
         out, _, _, new_carries, _ = self._forward(
-            self.params, self.states, x, train=False, rng=None,
-            initial_carries=carries)
+            self._dequant_params(self.params), self.states, x, train=False,
+            rng=None, initial_carries=carries)
         self._rnn_state = new_carries
         return out[:, -1] if squeeze and out.ndim == 3 else out
 

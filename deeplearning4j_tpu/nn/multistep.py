@@ -62,6 +62,51 @@ class MultiStepTrainable:
         self._jit_cache.clear()
         return self
 
+    # ------------------------------------------------- int8 serving weights
+    def quantize_weights(self, dtype="int8"):
+        """Per-channel symmetric int8 weight quantization for SERVING
+        (nn/quant.py, ROADMAP item 3): eligible weight leaves (floating,
+        ndim >= 2) are replaced in `self.params` by their int8 codes, and
+        every inference executable — output(), the decode engine's
+        step/prefill, rnn_time_step — traces a fused dequant
+        (`codes * per-channel scale`) on the way into the matmul, so HBM
+        holds and reads ~4x fewer weight bytes. The f32 originals are kept
+        as a host-side numpy backup (`dequantize_weights` restores them;
+        serializers write f32 zips). Training paths refuse a quantized
+        model. Shared by MultiLayerNetwork and ComputationGraph."""
+        if getattr(self, "_wq", None) is not None:
+            return self
+        if self.params is None:
+            self.init()
+        from .quant import WeightQuant
+        self._wq, self.params = WeightQuant.build(self.params, dtype=dtype)
+        self._jit_cache.clear()
+        return self
+
+    def dequantize_weights(self):
+        """Undo quantize_weights from the host-side f32 backup (used when a
+        deploy-time parity gate breaches)."""
+        wq = getattr(self, "_wq", None)
+        if wq is None:
+            return self
+        self.params = wq.restore_params(self.params)
+        self._wq = None
+        self._jit_cache.clear()
+        return self
+
+    def _dequant_params(self, params):
+        """Traced at the top of every inference executable: int8 code
+        leaves widen through their per-channel scales (closure constants);
+        identity for unquantized models."""
+        wq = getattr(self, "_wq", None)
+        return params if wq is None else wq.dequant(params)
+
+    def _check_trainable(self):
+        if getattr(self, "_wq", None) is not None:
+            raise RuntimeError(
+                "weights are int8-quantized (serving-only); call "
+                "dequantize_weights() before training")
+
     def generate(self, prompt_ids, max_new_tokens=20, stop_id=None,
                  max_len=None):
         """Greedy KV-cache autoregressive decode (decode/engine.py): feeds
@@ -116,6 +161,7 @@ class MultiStepTrainable:
         draws fresh rngs from the carried chain)."""
         if self.params is None:
             self.init()
+        self._check_trainable()
         # decide eligibility from the FIRST batch alone before paying the
         # host->device transfer for the whole group — an ineligible config
         # would otherwise re-prep (and re-transfer) every batch in the

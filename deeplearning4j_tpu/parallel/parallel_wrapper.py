@@ -32,12 +32,13 @@ class ParallelWrapper:
     def __init__(self, model, workers=None, prefetch_buffer=2,
                  averaging_frequency=1, average_updaters=True,
                  report_score_after_averaging=False, devices=None,
-                 zero=False):
+                 zero=False, moment_dtype=None):
         """zero=True turns on the ZeRO-1 sharded update (parallel/zero.py):
         updater state and the parameter update partition over the worker
         (data) axis instead of replicating on every worker — per-device
         optimizer-state HBM drops by the worker count, training math is
-        bit-identical (arXiv 2004.13336)."""
+        bit-identical (arXiv 2004.13336). moment_dtype="bf16"|"q8" stores
+        those sharded moments low-bit on top (nn/quant.py)."""
         self.model = model
         n_dev = len(devices or jax.devices())
         self.workers = workers or n_dev
@@ -51,7 +52,8 @@ class ParallelWrapper:
         mesh = make_mesh(n_data=self.workers, devices=devs)
         self.trainer = ShardedTrainer(model, mesh=mesh,
                                       rules=ShardingRules.data_parallel(),
-                                      shard_update=zero)
+                                      shard_update=zero,
+                                      moment_dtype=moment_dtype)
 
     # Builder-style API mirroring the reference
     class Builder:
@@ -79,8 +81,10 @@ class ParallelWrapper:
             self._kw["report_score_after_averaging"] = bool(flag)
             return self
 
-        def zero(self, flag=True):
+        def zero(self, flag=True, moment_dtype=None):
             self._kw["zero"] = bool(flag)
+            if moment_dtype is not None:
+                self._kw["moment_dtype"] = moment_dtype
             return self
 
         def build(self):

@@ -35,6 +35,19 @@ Checkpoints stay topology-independent: `to_canonical`/`from_canonical`
 convert between the sharded flat layout and the canonical per-param layout
 the serializers store, so a run checkpointed at N=8 resumes at N=4 (or
 unsharded) bit-for-bit — the resharding-on-replica-count-change contract.
+
+Low-bit moments (ROADMAP item 3, the bytes diet): `moment_dtype="bf16"|"q8"`
+stores the flat moment shards through nn.quant.MomentCodec — bf16 halves
+them, 8-bit block-wise absmax cuts them ~3.9x (codes + one pow2 scale per
+128-element block, both sharded over the axis). The codec rides INSIDE this
+layout: the stored state leaves keep fixed shapes/dtypes across steps (the
+traced update decodes, runs the layer's own optax transform in f32, and
+re-encodes), so donation still aliases and no train path retraces. The
+canonical checkpoint layout is UNCHANGED — to_canonical decodes to the
+same per-param f32 state every serializer already stores, from_canonical
+re-encodes for the target updater — and because the codec's round-trip is
+exact-idempotent (pow2 scales), conversion chains (8 -> 4 -> 8, elastic
+shrink/grow) replay the codes bit-for-bit instead of compounding error.
 """
 from __future__ import annotations
 
@@ -60,21 +73,34 @@ def _dict_path(path):
                     if isinstance(k, jax.tree_util.DictKey))
 
 
+def _leaf_device_bytes(leaf):
+    """Bytes `leaf` holds per device: sharded leaves count their shard
+    shape, replicated/unplaced leaves count in full."""
+    if not hasattr(leaf, "shape") or not hasattr(leaf, "dtype"):
+        return 0
+    sh = getattr(leaf, "sharding", None)
+    shape = (sh.shard_shape(leaf.shape)
+             if sh is not None and hasattr(sh, "shard_shape")
+             else leaf.shape)
+    return int(np.prod(shape, dtype=np.int64)) * np.dtype(leaf.dtype).itemsize
+
+
 def per_device_bytes(tree):
     """Bytes of `tree` RESIDENT PER DEVICE: sharded leaves count their shard
     shape, replicated/unplaced leaves count in full. This is the number the
     ZeRO claim is about — what each chip's HBM actually holds."""
-    total = 0
-    for leaf in jax.tree_util.tree_leaves(tree):
-        if not hasattr(leaf, "shape") or not hasattr(leaf, "dtype"):
-            continue
-        sh = getattr(leaf, "sharding", None)
-        shape = (sh.shard_shape(leaf.shape)
-                 if sh is not None and hasattr(sh, "shard_shape")
-                 else leaf.shape)
-        total += int(np.prod(shape, dtype=np.int64)) * \
-            np.dtype(leaf.dtype).itemsize
-    return int(total)
+    return int(sum(_leaf_device_bytes(leaf)
+                   for leaf in jax.tree_util.tree_leaves(tree)))
+
+
+def moment_bytes(tree):
+    """Per-device bytes of the >= 1-D optimizer-state leaves — the moment
+    pool the bytes diet targets (flat shards, q8 codes AND their per-block
+    scales); scalar schedule counts are excluded. Reported as the
+    `opt_moment_bytes_per_device` gauge/bench field."""
+    return int(sum(_leaf_device_bytes(leaf)
+                   for leaf in jax.tree_util.tree_leaves(tree)
+                   if getattr(leaf, "ndim", 0) >= 1))
 
 
 class ZeroUpdater:
@@ -86,13 +112,63 @@ class ZeroUpdater:
     converters keep checkpoints replica-count-independent.
     """
 
-    def __init__(self, mesh, axis=DATA_AXIS, rules=None):
+    def __init__(self, mesh, axis=DATA_AXIS, rules=None, moment_dtype=None,
+                 block=128):
         self.mesh = mesh
         self.axis = axis
         self.n_shards = int(mesh.shape[axis])
         self.rules = rules
         self.shard = NamedSharding(mesh, P(axis))
         self.replicated = NamedSharding(mesh, P())
+        # low-bit moments: "bf16" / "q8" store the flat shards through the
+        # MomentCodec (nn/quant.py); None/"f32" keeps full precision
+        self.moment_dtype = ("f32" if moment_dtype in (None, "f32")
+                             else str(moment_dtype))
+        self.codec = None
+        if self.moment_dtype != "f32":
+            from ..nn.quant import MomentCodec
+            self.codec = MomentCodec(self.moment_dtype,
+                                     n_shards=self.n_shards, block=block)
+
+    # ------------------------------------------------------- moment codec
+    def _encode_state(self, st, layer_params):
+        """Flat f32 moment leaves of one layer's optax state -> the stored
+        low-bit representation (identity without a codec). Only leaves that
+        ARE flat padded moments encode — matched by the same padded-length
+        test to_canonical uses — so schedule counts/hyperparams stay put."""
+        if self.codec is None:
+            return st
+        n = self.n_shards
+        pmap = _param_paths(layer_params)
+
+        def conv(path, leaf, pmap=pmap):
+            w = pmap.get(_dict_path(path))
+            if (w is not None and getattr(leaf, "ndim", 0) == 1
+                    and hasattr(leaf, "dtype")
+                    and jnp.issubdtype(leaf.dtype, jnp.floating)
+                    and leaf.dtype != jnp.bfloat16
+                    and leaf.shape[0] == _pad_len(w.size, n)):
+                return self.codec.encode(leaf)
+            return leaf
+        return jax.tree_util.tree_map_with_path(conv, st)
+
+    def _decode_state(self, st, layer_params):
+        """Stored low-bit moments -> flat f32 (identity without a codec);
+        traced at the top of the update so the optax math runs full
+        precision on 1/N-sized shards."""
+        if self.codec is None:
+            return st
+        n = self.n_shards
+        pmap = _param_paths(layer_params)
+
+        def conv(path, leaf, pmap=pmap):
+            if self.codec.is_encoded(leaf):
+                w = pmap.get(_dict_path(path))
+                if w is not None:
+                    return self.codec.decode(leaf, _pad_len(w.size, n))
+            return leaf
+        return jax.tree_util.tree_map_with_path(
+            conv, st, is_leaf=self.codec.is_encoded)
 
     # ------------------------------------------------------------ inclusion
     def included(self, layer_key, layer_params):
@@ -146,8 +222,9 @@ class ZeroUpdater:
             state = {}
             for k, sub in ps.items():
                 if incl[k]:
-                    state[k] = transforms[k].init(
-                        jax.tree_util.tree_map(flat, sub))
+                    state[k] = self._encode_state(
+                        transforms[k].init(jax.tree_util.tree_map(flat, sub)),
+                        sub)
                 else:
                     state[k] = transforms[k].init(sub)
             return self.place_opt_state(state, ps)
@@ -168,8 +245,13 @@ class ZeroUpdater:
                     continue
                 gf = jax.tree_util.tree_map(flat, g)
                 pf = jax.tree_util.tree_map(flat, ps[k])
-                uf, st = tx.update(gf, state[k], pf)
-                new_state[k] = keep_sharded(st)
+                # low-bit moments decode to f32 shards for the layer's own
+                # optax math, then re-encode for storage — all inside the
+                # traced step, so the STORED leaves keep fixed shapes/dtypes
+                # (donation aliases; zero retraces)
+                uf, st = tx.update(gf, self._decode_state(state[k], ps[k]),
+                                   pf)
+                new_state[k] = keep_sharded(self._encode_state(st, ps[k]))
                 ups[k] = jax.tree_util.tree_map(unflat, uf, ps[k])
             return ups, new_state
 
@@ -219,11 +301,18 @@ class ZeroUpdater:
 
             def conv(path, leaf, pmap=pmap):
                 w = pmap.get(_dict_path(path))
-                if (w is not None and getattr(leaf, "ndim", 0) == 1
+                if w is None:
+                    return leaf
+                if self.codec is not None and self.codec.is_encoded(leaf):
+                    v = self.codec.decode(leaf, _pad_len(w.size, n))
+                    return v[:w.size].reshape(w.shape)
+                if (getattr(leaf, "ndim", 0) == 1
                         and leaf.shape[0] == _pad_len(w.size, n)):
                     return jnp.asarray(leaf)[:w.size].reshape(w.shape)
                 return leaf
-            out[k] = jax.tree_util.tree_map_with_path(conv, st)
+            out[k] = jax.tree_util.tree_map_with_path(
+                conv, st,
+                is_leaf=self.codec.is_encoded if self.codec else None)
         return out
 
     def from_canonical(self, opt_state, params):
@@ -247,6 +336,13 @@ class ZeroUpdater:
                     pad = _pad_len(v.shape[0], n) - v.shape[0]
                     if pad:
                         v = jnp.pad(v, (0, pad))
+                    if self.codec is not None and \
+                            jnp.issubdtype(v.dtype, jnp.floating):
+                        # device_put over the encoded pytree: codes AND
+                        # per-block scales both shard over the axis
+                        return jax.device_put(
+                            self.codec.encode(jnp.asarray(v, jnp.float32)),
+                            self.shard)
                     return jax.device_put(v, self.shard)
                 return leaf
             out[k] = jax.tree_util.tree_map_with_path(conv, st)

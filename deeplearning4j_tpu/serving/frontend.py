@@ -544,9 +544,11 @@ class FleetFrontend(BackgroundHttpServer):
                                       replica=d.get("replica"))
             handler.send_json(200, {"canary": state}, default=str)
             return
-        results = self.broadcast("/deploy", {
-            "version": version, **({"path": d["path"]} if "path" in d
-                                   else {})})
+        # quantize/parity options forward verbatim: each replica runs its
+        # OWN parity gate (per-replica fail-closed, like warm-up)
+        extra = {k: d[k] for k in ("path", "quantize", "parity_inputs")
+                 if k in d}
+        results = self.broadcast("/deploy", {"version": version, **extra})
         ok = [n for n, r in results.items()
               if isinstance(r, dict) and "error" not in r]
         for replica in self.replicas:
@@ -558,8 +560,7 @@ class FleetFrontend(BackgroundHttpServer):
         self.logger.info("fleet_deploy", version=version, ok=len(ok),
                          failed=len(results) - len(ok))
         self.publish_registry_event({"kind": "deploy", "version": version,
-                                     **({"path": d["path"]} if "path" in d
-                                        else {})})
+                                     **extra})
         handler.send_json(200 if len(ok) == len(results) else 502,
                           {"version": version, "results": results},
                           default=str)
@@ -712,8 +713,13 @@ class RegistrySubscriber:
                 reg.scan()             # the zip may have just landed
             version = str(event["version"])
             known = any(v["version"] == version for v in reg.versions())
+            # quantize rides the event: a late-joining / autoscaled replica
+            # comes up serving the SAME int8 executables as the fleet, its
+            # own parity gate included
             self.server.deploy(version,
-                               path=None if known else event.get("path"))
+                               path=None if known else event.get("path"),
+                               quantize=event.get("quantize"),
+                               parity_inputs=event.get("parity_inputs"))
             return True
         if kind == "scan":
             return bool(self.server.registry.scan())

@@ -44,6 +44,8 @@ class ModelVersion:
         self.fmt = fmt                       # zip format.json, when file-backed
         self.transform = transform           # e.g. a fitted DataNormalizer
         self._device_transform = None        # lazily lowered (False = can't)
+        self.quantized = None                # "int8" once quantize() applied
+        self.parity = None                   # quantize()'s parity report
         self.loaded_at = now_s()
         self.deployed_at = None
         self.serve_count = AtomicCounter()   # rows served by this version
@@ -86,6 +88,25 @@ class ModelVersion:
         except Exception:
             return False            # unfitted/exotic transform: host path
 
+    def quantize(self, dtype="int8", parity_inputs=None, gate=None):
+        """Quantize this version's weights for serving (nn/quant.py:
+        per-channel symmetric int8, dequant fused into the jitted
+        executables so HBM reads the narrow weights), GATED on accuracy
+        parity when `parity_inputs` are given: a breach restores the f32
+        weights and raises QuantParityError — the version keeps serving
+        full precision. Idempotent per dtype; returns the parity report."""
+        if self.quantized is not None:
+            if self.quantized == str(dtype):
+                return self.parity
+            raise ValueError(
+                f"version {self.version!r} already quantized to "
+                f"{self.quantized!r}")
+        from ..nn.quant import quantize_model_weights
+        self.parity = quantize_model_weights(
+            self.model, dtype=dtype, parity_inputs=parity_inputs, gate=gate)
+        self.quantized = str(dtype)
+        return self.parity
+
     def revert_outputs(self, y):
         """Un-normalize model outputs for normalizers fitted with
         fit_labels=True (regression label space); identity otherwise."""
@@ -102,6 +123,8 @@ class ModelVersion:
             "format": self.fmt,
             "normalizer": type(self.transform).__name__
             if self.transform is not None else None,
+            "quantized": self.quantized,
+            "parity": self.parity,
             "loaded_at": self.loaded_at,
             "deployed_at": self.deployed_at,
             "serve_count": self.serve_count.get(),
@@ -224,14 +247,21 @@ class ModelRegistry:
             return self._versions[str(version)]
 
     # ---- deploy / rollback -------------------------------------------------
-    def deploy(self, version, warmup=None):
+    def deploy(self, version, warmup=None, quantize=None, parity_inputs=None,
+               gate=None):
         """Atomically make `version` the serving model. `warmup(model)` runs
         BEFORE the swap (old version serves until it completes), so steady
         state never sees a cold executable. Returns the previous version.
 
         A version that is not registered but exists as `<scan_dir>/
         <version>.zip` is loaded first — deploy-by-name from the persistent
-        registry directory."""
+        registry directory.
+
+        quantize="int8" quantizes the version's weights for serving BEFORE
+        the warm-up (so the warmed executables are the int8 ones the steady
+        state dispatches), gated on accuracy parity over `parity_inputs`
+        (nn.quant.QuantGate) — a breach fails the deploy with the version
+        restored to f32 and the previously active version still serving."""
         version = str(version)
         with self._deploy_lock:
             with self._lock:
@@ -247,8 +277,22 @@ class ModelRegistry:
                 if version not in self._versions:
                     raise KeyError(f"unknown version {version!r}")
                 mv = self._versions[version]
-            if warmup is not None:
-                warmup(mv.model)
+            applied_quant = False
+            if quantize:
+                applied_quant = mv.quantized is None
+                mv.quantize(quantize, parity_inputs=parity_inputs, gate=gate)
+            try:
+                if warmup is not None:
+                    warmup(mv.model)
+            except Exception:
+                if applied_quant:
+                    # a failed warm-up must not leave the version silently
+                    # quantized: a LATER plain deploy(v) would then serve
+                    # int8 weights nobody asked that deploy for
+                    mv.model.dequantize_weights()
+                    mv.quantized = None
+                    mv.parity = None
+                raise
             with self._lock:
                 if version not in self._versions:
                     # concurrently unregistered during warm-up: activating it

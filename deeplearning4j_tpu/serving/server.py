@@ -68,7 +68,7 @@ class ServingServer(BackgroundHttpServer):
                  alert_interval_s=5.0, log_sinks=None,
                  seq_len_bucketing=True, decode=False, decode_slots=4,
                  decode_max_len=128, decode_queue_capacity=64,
-                 decode_max_new_tokens=32):
+                 decode_max_new_tokens=32, quant_gate=None):
         # scan_dir: persistent registry directory — every ModelSerializer zip
         # in it is loaded at startup and POST /deploy accepts any model name
         # from it (see ModelRegistry.scan / deploy-by-name)
@@ -95,6 +95,9 @@ class ServingServer(BackgroundHttpServer):
                                       tracer=self.tracer,
                                       compile_tracker=self.compile_tracker)
         self.default_timeout_ms = default_timeout_ms
+        # accuracy-parity thresholds for quantize="int8" deploys (None ->
+        # nn.quant.QuantGate defaults)
+        self.quant_gate = quant_gate
         self.stats_router = stats_router
         self.router_interval_s = float(router_interval_s)
         self._last_router_flush = None     # None: never flushed
@@ -309,20 +312,60 @@ class ServingServer(BackgroundHttpServer):
             self._abandon(fut)
             raise
 
-    def deploy(self, version, path=None):
+    def deploy(self, version, path=None, quantize=None, parity_inputs=None):
         """Load (optional) + warm-up + atomic swap; returns prior version.
         If this call registered the version from `path` and the deploy then
         fails (e.g. warm-up error), the registration is rolled back so the
-        identical request can simply be retried."""
+        identical request can simply be retried.
+
+        quantize="int8" serves the version with per-channel int8 weights
+        (nn/quant.py) behind an accuracy-parity gate: parity rows come from
+        the request (`parity_inputs`), else are synthesized from the
+        model's configured input shape; a gate breach fails the deploy with
+        the f32 weights restored and the old version still serving."""
         loaded = path is not None
         if loaded:
             self.registry.load(version, path)
         try:
-            return self.registry.deploy(version, warmup=self._warmup)
+            pin = None
+            if quantize:
+                pin = self._parity_inputs(version, parity_inputs)
+            return self.registry.deploy(version, warmup=self._warmup,
+                                        quantize=quantize,
+                                        parity_inputs=pin,
+                                        gate=self.quant_gate)
         except Exception:
             if loaded:
                 self.registry.unregister(version)
             raise
+
+    def _parity_inputs(self, version, explicit):
+        """Parity rows for a quantized deploy: the request's own rows when
+        given, else a deterministic synthetic batch shaped from the model's
+        configured input type (nn.quant.synthetic_parity_inputs)."""
+        if explicit is not None:
+            return np.asarray(explicit, np.float32)
+        from ..nn.quant import synthetic_parity_inputs
+        try:
+            mv = self.registry.get(version)
+        except KeyError:
+            # deploy-by-name: the zip is in scan_dir but not registered yet
+            # (registry.deploy would load it AFTER this); resolve it now so
+            # a quantized by-name deploy works like a plain one
+            spath = self.registry._scan_path(str(version))
+            if spath is None:
+                raise
+            try:
+                self.registry.load(version, spath)
+            except ValueError:
+                pass            # a concurrent scan registered it: fine
+            mv = self.registry.get(version)
+        x = synthetic_parity_inputs(mv.model)
+        if x is None:
+            raise ValueError(
+                "quantized deploy needs parity_inputs: the model conf "
+                "carries no input shape to synthesize them from")
+        return x
 
     def _warmup(self, model):
         """Deploy-time warm-up: batcher buckets AND (when the decode plane
@@ -426,10 +469,17 @@ class ServingServer(BackgroundHttpServer):
                         server._handle_generate(self)
                     elif self.path == "/deploy":
                         d = json.loads(self.body() or b"{}")
-                        prev = server.deploy(d["version"], path=d.get("path"))
-                        self.send_json(200, {
-                            "active": server.registry.active_version,
-                            "previous": prev})
+                        prev = server.deploy(
+                            d["version"], path=d.get("path"),
+                            quantize=d.get("quantize"),
+                            parity_inputs=d.get("parity_inputs"))
+                        info = {"active": server.registry.active_version,
+                                "previous": prev}
+                        if d.get("quantize"):
+                            mv = server.registry.get(d["version"])
+                            info["quantized"] = mv.quantized
+                            info["parity"] = mv.parity
+                        self.send_json(200, info)
                     elif self.path == "/rollback":
                         active = server.rollback()
                         self.send_json(200, {"active": active})

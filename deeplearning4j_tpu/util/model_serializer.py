@@ -101,6 +101,13 @@ class ModelSerializer:
         from ..nn.graph.graph import ComputationGraph
         is_graph = isinstance(model, ComputationGraph) or \
             getattr(model, "model_class", None) == "ComputationGraph"
+        # int8-quantized serving weights (nn/quant.py): zips stay f32 — the
+        # host-side backup rebuilds the full-precision tree, so a restore
+        # (or a later re-quantized deploy) never compounds quantization
+        params = model.params
+        wq = getattr(model, "_wq", None)
+        if wq is not None:
+            params = wq.restore_params(params)
         target = path if hasattr(path, "write") else io.BytesIO()
         with zipfile.ZipFile(target, "w", zipfile.ZIP_DEFLATED) as zf:
             _writestr(zf, FORMAT_ENTRY, json.dumps({
@@ -110,7 +117,7 @@ class ModelSerializer:
                 "version": 1,
             }))
             _writestr(zf, CONFIG_ENTRY, model.conf.to_json())
-            _writestr(zf, COEFFICIENTS_ENTRY, _tree_to_npz_bytes(model.params))
+            _writestr(zf, COEFFICIENTS_ENTRY, _tree_to_npz_bytes(params))
             if model.states:
                 _writestr(zf, STATE_ENTRY, _tree_to_npz_bytes(model.states))
             if save_updater and model.opt_state is not None:

@@ -123,6 +123,13 @@ import os
 def publish(tmp, final):
     os.replace(tmp, final)
 """, [4]),
+    "GL014": ("""\
+import numpy as np
+
+def report_moments(state):
+    mu = state["mu"].astype(np.float32)
+    return mu
+""", [4]),
 }
 
 
@@ -791,6 +798,51 @@ def test_gl013_repo_publishers_are_durable():
     assert report.violations == [], [str(v) for v in report.violations]
 
 
+def test_gl014_edges():
+    """The designated quant modules (nn/quant.py, parallel/zero.py) are
+    allowed; non-quant receivers, non-widening dtypes, and variable dtypes
+    stay quiet; the ctor (`jnp.float32(qcodes)`), asarray-dtype=, and
+    constant-subscript-key forms all fire."""
+    src = SEEDS["GL014"][0]
+    assert lint(src, rel_path="deeplearning4j_tpu/nn/quant.py") == []
+    assert lint(src, rel_path="deeplearning4j_tpu/parallel/zero.py") == []
+    quiet = textwrap.dedent("""\
+    import numpy as np
+    import jax.numpy as jnp
+
+    def fine(x, qcodes, scales, in_dt, quantile, quantity):
+        a = x.astype(np.float32)          # non-quant name
+        b = qcodes.astype(jnp.bfloat16)   # narrowing, not f32/f64
+        c = scales.astype(in_dt)          # variable dtype: unprovable
+        d = quantile.astype(np.float32)   # 'quant' prefix != quant token
+        e = quantity.astype(np.float32)
+        return a, b, c, d, e
+    """)
+    assert lint(quiet, rules=["GL014"]) == []
+    forms = textwrap.dedent("""\
+    import numpy as np
+    import jax.numpy as jnp
+
+    def widen(state, qcodes, scales):
+        a = jnp.float32(qcodes)
+        b = np.asarray(scales, dtype=np.float64)
+        c = state["qcodes"].astype("float32")
+        return a, b, c
+    """)
+    flagged = lint(forms, rules=["GL014"])
+    assert [v.line for v in flagged] == [5, 6, 7], flagged
+
+
+def test_gl014_repo_gate_quant_stays_narrow():
+    """Satellite gate: zero GL014 findings across the package + tools —
+    every widening of quantized moment/weight leaves goes through the
+    nn/quant codecs (or parallel/zero.py's canonical conversion)."""
+    report = Analyzer(rules=[get_rule("GL014")], root=str(REPO)).analyze_paths(
+        ["deeplearning4j_tpu", "tools"])
+    assert report.errors == []
+    assert report.violations == [], [str(v) for v in report.violations]
+
+
 # ---------------------------------------------------------------- baseline
 
 def test_baseline_round_trip_via_cli(tmp_path):
@@ -921,7 +973,7 @@ def test_cli_rule_subset_and_list_rules():
         assert rule.id in proc.stdout and rule.rationale
     assert [r.id for r in all_rules()] == \
         ["GL001", "GL002", "GL003", "GL004", "GL005", "GL006", "GL007",
-         "GL008", "GL009", "GL010", "GL011", "GL012", "GL013"]
+         "GL008", "GL009", "GL010", "GL011", "GL012", "GL013", "GL014"]
 
 
 def test_repo_gate_is_clean_and_fast():
