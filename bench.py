@@ -764,6 +764,69 @@ def bench_loadgen(rate=300.0, duration_s=2.0, n_replicas=3, seed=0):
     return out
 
 
+def bench_mesh_serving(batch=64, steps=30, trials=3):
+    """Mesh-sharded serving dispatch (serving/mesh.py, ROADMAP item 1): the
+    SAME coalesced /predict batch through one chip vs a MeshDispatcher on
+    the 8-virtual-device mesh — replica-parallel (batch split over the data
+    axis) and tensor-parallel (weights split over the model axis, the
+    serve-models-that-OOM-one-chip mode, reported with its measured
+    per-chip param bytes). Runs in a subprocess (bench_scaling_subprocess
+    style) so the forced device count can't leak into the other workloads.
+    The 8 virtual devices share ONE physical CPU, so the speedup is
+    rig-bound here (`mesh_serving_rig_bound`); the >=1.5x acceptance guard
+    arms only on a real multi-chip platform."""
+    code = f"BATCH, STEPS, TRIALS = {batch}, {steps}, {trials}\n" + r"""
+import os, time, json
+os.environ["XLA_FLAGS"] = os.environ.get("XLA_FLAGS", "") + \
+    " --xla_force_host_platform_device_count=8"
+import jax
+jax.config.update("jax_platforms", "cpu")
+import numpy as np
+from deeplearning4j_tpu.zoo.models import mlp_mnist
+from deeplearning4j_tpu.serving.mesh import MeshContext
+
+rng = np.random.default_rng(0)
+x = rng.random((BATCH, 784)).astype(np.float32)
+
+def sps(call):
+    jax.block_until_ready(call(x))      # compile + place outside the clock
+    best = float("inf")
+    for _ in range(TRIALS):
+        t0 = time.perf_counter()
+        for _ in range(STEPS):
+            out = call(x)
+        jax.block_until_ready(out)
+        best = min(best, time.perf_counter() - t0)
+    return BATCH * STEPS / best
+
+sps_1 = sps(mlp_mnist(hidden=512).init().output)
+dp = MeshContext({"n_data": 8}).wrap(mlp_mnist(hidden=512).init())
+sps_dp = sps(dp.output)
+tp = MeshContext({"n_data": 4, "n_model": 2,
+                  "rules": "tensor_parallel"}).wrap(
+    mlp_mnist(hidden=512).init())
+per_chip, total = tp.param_shard_bytes()
+sps_tp = sps(tp.output)
+print(json.dumps({
+    "sps_single": sps_1, "sps_mesh": sps_dp, "sps_mesh_tp": sps_tp,
+    "chips": dp.mesh_context.chips,
+    "platform": jax.devices()[0].platform,
+    "tp_param_bytes_per_chip": per_chip,
+    "tp_param_bytes_total": total}))
+"""
+    env = dict(os.environ)
+    env.pop("JAX_PLATFORMS", None)
+    out = subprocess.run([sys.executable, "-c", code], capture_output=True,
+                         env=env, timeout=600, cwd=os.path.dirname(
+                             os.path.abspath(__file__)))
+    import warnings
+    for wline in out.stderr.decode(errors="replace").splitlines():
+        if "donated buffers were not usable" in wline:
+            warnings.warn(wline)
+    line = out.stdout.decode().strip().splitlines()[-1]
+    return json.loads(line)
+
+
 def bench_ckpt(hidden=1024, reps=7):
     """Durable-checkpoint cost (the robustness PR's measurable win): what
     the TRAINING THREAD pays per checkpoint, async (one host device-get
@@ -815,7 +878,8 @@ WATCHED_METRICS = ("value", "lenet_samples_per_sec", "char_rnn_chars_per_sec",
                    "transformer_lm_tokens_per_sec", "word2vec_pairs_per_sec",
                    "flash_speedup", "e2e_samples_per_sec", "e2e_vs_compute",
                    "ucidigits_test_acc", "real32_test_acc",
-                   "decode_tokens_per_sec", "loadgen_achieved_rate")
+                   "decode_tokens_per_sec", "loadgen_achieved_rate",
+                   "serving_samples_per_sec", "serving_samples_per_sec_mesh")
 # lower-is-better latency metrics: best prior = the MINIMUM, and a >50%
 # degradation (1.5x the best) lands in "regressions" (wider margin than the
 # throughput 30%: single-request latency is noisier on the shared relay)
@@ -1140,6 +1204,7 @@ def main():
                ("decode", lambda: bench_decode()),
                ("word2vec", lambda: bench_word2vec()),
                ("loadgen", lambda: bench_loadgen()),
+               ("mesh", lambda: bench_mesh_serving()),
                ("ckpt", lambda: bench_ckpt()),
                ("scaling", lambda: bench_scaling_subprocess())]
     if headline_is_resnet:
@@ -1243,6 +1308,29 @@ def main():
                     "spmd_strong_ratio): achieved-vs-offered and p99 are "
                     "the guarded capacity numbers, not a linear-scaling "
                     "claim")
+            elif name == "mesh":
+                # mesh-sharded serving: one dispatch, all chips. The
+                # speedup guard arms only off-rig (real multi-chip
+                # platform); here the 8 virtual devices share one CPU
+                extras["serving_samples_per_sec"] = round(r["sps_single"], 1)
+                extras["serving_samples_per_sec_mesh"] = round(
+                    r["sps_mesh"], 1)
+                extras["serving_samples_per_sec_mesh_tp"] = round(
+                    r["sps_mesh_tp"], 1)
+                extras["mesh_serving_speedup"] = round(
+                    r["sps_mesh"] / r["sps_single"], 2)
+                extras["mesh_serving_chips"] = r["chips"]
+                extras["mesh_tp_param_bytes_per_chip"] = int(
+                    r["tp_param_bytes_per_chip"])
+                extras["mesh_tp_param_bytes_total"] = int(
+                    r["tp_param_bytes_total"])
+                extras["mesh_serving_rig_bound"] = (
+                    r["platform"] == "cpu")
+                extras["mesh_serving_note"] = (
+                    "rig-bound: 8 virtual devices share ONE physical CPU "
+                    "(spmd_strong_ratio style) — the speedup here measures "
+                    "partitioning overhead only; the >=1.5x mesh dispatch "
+                    "guard arms on real multi-chip platforms")
             elif name == "ckpt":
                 extras["ckpt_blocking_ms"] = round(r["ckpt_blocking_ms"], 2)
                 extras["ckpt_sync_ms"] = round(r["ckpt_sync_ms"], 2)
@@ -1365,6 +1453,19 @@ def main():
                  "now": round(float(qd), 4),
                  "detail": "int8-quantized serving accuracy dropped beyond "
                            "the parity gate"})
+    # mesh-serving guard (rig-aware): on a REAL multi-chip platform the
+    # replica-parallel dispatch must clear 1.5x over one chip at 8 chips;
+    # on this rig's virtual CPU mesh (one shared core) the guard stays
+    # disarmed — the number measures partitioning overhead, not scaling
+    msp = extras.get("mesh_serving_speedup")
+    if extras.get("mesh_serving_rig_bound") is False \
+            and isinstance(msp, (int, float)) \
+            and extras.get("mesh_serving_chips", 0) >= 8 and msp < 1.5:
+        out["regressions"].append(
+            {"metric": "mesh_serving_speedup", "best_prior": 1.5,
+             "now": round(float(msp), 2),
+             "detail": "mesh dispatch under 1.5x of single-chip serving "
+                       "throughput on a real multi-chip platform"})
     # durable-checkpoint guard: the async path's blocking time must sit
     # STRICTLY below the synchronous write — otherwise the background
     # writer is buying nothing and the training thread re-pays the fsync

@@ -1,4 +1,4 @@
-"""GL001–GL014: the rule catalog (see RULES.md for the bug-history rationale).
+"""GL001–GL015: the rule catalog (see RULES.md for the bug-history rationale).
 
 Each rule is intra-file AST analysis with light import resolution: aliases
 from ``import x as y`` / ``from m import n as y`` are resolved so
@@ -1104,3 +1104,97 @@ class QuantSilentWideningRule(Rule):
                 and isinstance(node.slice.value, str):
             return node.slice.value
         return None
+
+
+# ---------------------------------------------------------------------------
+# GL015 — mesh-replicated-dispatch
+# ---------------------------------------------------------------------------
+
+@register
+class MeshReplicatedDispatchRule(Rule):
+    """Batch placement in serving/decode hot paths without a sharding."""
+
+    id = "GL015"
+    name = "mesh-replicated-dispatch"
+    rationale = (
+        "Mesh-sharded serving (ROADMAP item 1, serving/mesh.py) only "
+        "splits a /predict wave across chips if the batch is PLACED with a "
+        "NamedSharding before the jitted forward: a bare jax.device_put "
+        "(or an implicit jnp.asarray placement) in a serving/decode "
+        "dispatch path commits the whole batch to device 0, XLA compiles "
+        "a replicated executable, and N-1 chips idle while reporting a "
+        "healthy mesh — throughput silently collapses to single-chip with "
+        "no error anywhere. In serving/ and decode/ hot paths, every "
+        "device placement of a batch-shaped operand must flow through a "
+        "NamedSharding / with_sharding_constraint / *_sharding helper (or "
+        "sit in a visibly sharding-aware statement).")
+
+    #: the modules whose dispatch paths feed mesh executables
+    HOT_PREFIXES = ("deeplearning4j_tpu/serving/",
+                    "deeplearning4j_tpu/decode/")
+    #: functions that ARE the dispatch hot path (batcher dispatch, model
+    #: forward, decode legs) — implicit placement only matters where the
+    #: batch meets the executable
+    HOT_FN_RE = re.compile(
+        r"dispatch|output|predict|prefill|step|generate|warmup",
+        re.IGNORECASE)
+    _PLACERS = ("jax.device_put",)
+    _IMPLICIT = ("jax.numpy.asarray", "jax.numpy.array", "jax.numpy.stack")
+    _SHARDY = re.compile(r"shard", re.IGNORECASE)
+
+    def check(self, ctx):
+        if not ctx.rel_path.startswith(self.HOT_PREFIXES):
+            return
+        aliases = ctx.aliases
+        for node in ctx.nodes:
+            qual = call_qual(node, aliases)
+            if qual in self._PLACERS:
+                if not self._sharding_aware(self._statement(ctx, node)):
+                    yield self.violation(
+                        ctx, node,
+                        "device_put without a NamedSharding in a "
+                        "serving/decode hot path commits the operand to one "
+                        "device — the mesh executable replicates and N-1 "
+                        "chips idle; place through mesh.batch_sharding / "
+                        "cache_sharding (or an explicit NamedSharding)")
+            elif qual in self._IMPLICIT:
+                fn = enclosing_function(ctx, node)
+                if fn is not None and self.HOT_FN_RE.search(fn.name) \
+                        and not self._sharding_aware(fn):
+                    yield self.violation(
+                        ctx, node,
+                        f"{qual.split('.')[-1]} in dispatch hot path "
+                        f"`{fn.name}` places the batch implicitly on device "
+                        "0 with no sharding anywhere in the function; "
+                        "np.asarray on the host side, then device_put under "
+                        "the mesh batch sharding")
+
+    @staticmethod
+    def _statement(ctx, node):
+        """Nearest enclosing statement — the visibility scope for 'is this
+        placement sharding-aware': `tree_map(lambda l, s: device_put(l, s),
+        cache, self.cache_shardings())` is aware through its sibling arg."""
+        for anc in ctx.ancestors(node):
+            if isinstance(anc, ast.stmt):
+                return anc
+        return node
+
+    @classmethod
+    def _sharding_aware(cls, tree):
+        """Any identifier/attribute/arg name containing 'shard' in the
+        subtree (NamedSharding, with_sharding_constraint, batch_sharding,
+        even_sharding, pshard, out_shardings=...)."""
+        if tree is None:
+            return False
+        for sub in ast.walk(tree):
+            if isinstance(sub, ast.Name) and cls._SHARDY.search(sub.id):
+                return True
+            if isinstance(sub, ast.Attribute) \
+                    and cls._SHARDY.search(sub.attr):
+                return True
+            if isinstance(sub, ast.keyword) and sub.arg \
+                    and cls._SHARDY.search(sub.arg):
+                return True
+            if isinstance(sub, ast.arg) and cls._SHARDY.search(sub.arg):
+                return True
+        return False

@@ -109,9 +109,12 @@ def _check_layer(name, module):
 
 def build_plan(model):
     """(nodes, input_name, output_name, vocab) for a MultiLayerNetwork or a
-    single-input/single-output ComputationGraph."""
+    single-input/single-output ComputationGraph. A mesh-serving wrapper
+    (serving/mesh.MeshDispatcher) is planned through the model it wraps —
+    duck-typed on `mesh_inner` so decode/ never imports serving/."""
     from ..nn.graph.graph import ComputationGraph
     from ..nn.multilayer.network import MultiLayerNetwork
+    model = getattr(model, "mesh_inner", model)
     if isinstance(model, MultiLayerNetwork):
         it = getattr(model.conf, "input_type", None)
         vocab = int(it.size) if it is not None and hasattr(it, "size") \
@@ -178,14 +181,21 @@ class DecodeEngine:
                            else self._dtype)
         self.compile_tracker = compile_tracker
         self.registry = registry            # MetricsRegistry for jit counters
+        # mesh-sharded decode (serving/mesh.py): a wrapped model carries the
+        # serving MeshContext; the KV cache partitions its head axis over
+        # the mesh model axis and the step/prefill executables pin the
+        # cache's out_shardings so donation survives partitioning
+        self.mesh = getattr(model, "mesh_context", None)
+        self._cache_shardings = None        # lazily built pytree
         self._step_fn = None
         self._prefill_fns = {}              # length bucket -> jitted fn
         self._compiled = set()              # labels whose first call was timed
         self._jit_lock = threading.Lock()
 
     # ------------------------------------------------------------ cache
-    def init_cache(self):
-        """Fresh all-zero cache pytree (slot lengths all 0)."""
+    def _cache_zeros(self):
+        """Abstract cache construction (shapes/dtypes only — placement is
+        `init_cache`'s job, so `cache_bytes` can eval_shape this)."""
         layers = {}
         for node in self.nodes:
             if node.kind != "layer":
@@ -205,11 +215,41 @@ class DecodeEngine:
         return {"lengths": jnp.zeros((self.slots,), jnp.int32),
                 "layers": layers}
 
-    def cache_bytes(self):
+    def init_cache(self):
+        """Fresh all-zero cache pytree (slot lengths all 0); on a serving
+        mesh every entry is placed under its head-sharded NamedSharding."""
+        cache = self._cache_zeros()
+        if self.mesh is None:
+            return cache
+        return jax.tree_util.tree_map(
+            lambda leaf, s: jax.device_put(leaf, s), cache,
+            self.cache_shardings())
+
+    def cache_shardings(self):
+        """NamedSharding pytree matching the cache (mesh only): attention
+        K/V [slots, capacity, H, Dh] shard heads over the model axis,
+        recurrent carries shard features, lengths replicate."""
+        if self._cache_shardings is None:
+            shapes = jax.eval_shape(self._cache_zeros)
+            self._cache_shardings = jax.tree_util.tree_map(
+                lambda leaf: self.mesh.cache_sharding(leaf.shape), shapes)
+        return self._cache_shardings
+
+    def cache_bytes(self, per_shard=False):
         # eval_shape: sizes from the abstract pytree, no device allocation
-        shapes = jax.eval_shape(self.init_cache)
-        return sum(int(x.size * x.dtype.itemsize)
-                   for x in jax.tree_util.tree_leaves(shapes))
+        shapes = jax.eval_shape(self._cache_zeros)
+        if not per_shard or self.mesh is None:
+            return sum(int(x.size * x.dtype.itemsize)
+                       for x in jax.tree_util.tree_leaves(shapes))
+        # per-shard: what ONE chip holds resident — the honest capacity
+        # number for admission and gauges on a mesh (a head-sharded entry
+        # puts 1/n_model of its bytes on each chip; uneven entries stay
+        # replicated and count whole)
+        total = 0
+        for x in jax.tree_util.tree_leaves(shapes):
+            nbytes = int(x.size * x.dtype.itemsize)
+            total += nbytes // self.mesh.cache_shard_count(x.shape)
+        return total
 
     # ------------------------------------------------------------ walks
     def _walk_prefill(self, params, states, x0, mask, cache, slot, length):
@@ -326,7 +366,7 @@ class DecodeEngine:
             return new_cache, jnp.argmax(probs, axis=-1).astype(jnp.int32), \
                 probs
 
-        return jax.jit(step_fn, donate_argnums=(2,))
+        return jax.jit(step_fn, donate_argnums=(2,), **self._jit_sharding())
 
     def _build_prefill(self, L):
         def prefill_fn(params, states, cache, slot, ids, length):
@@ -344,7 +384,42 @@ class DecodeEngine:
                          "layers": layers}
             return new_cache, jnp.argmax(probs).astype(jnp.int32), probs
 
-        return jax.jit(prefill_fn, donate_argnums=(2,))
+        return jax.jit(prefill_fn, donate_argnums=(2,),
+                       **self._jit_sharding())
+
+    def _jit_sharding(self):
+        """Extra jit kwargs on a mesh: pin the output cache to the SAME
+        head-sharded placement as the donated input cache, so GSPMD's
+        propagation can never pick a layout that breaks buffer donation —
+        the zero-fresh-allocation steady state (GL011's sibling invariant)
+        holds sharded exactly as it does on one chip. Token ids and probs
+        replicate (they're host-read every step)."""
+        if self.mesh is None:
+            return {}
+        repl = self.mesh.cache_sharding(())     # replicated NamedSharding
+        return {"out_shardings": (self.cache_shardings(), repl, repl)}
+
+    def _ensure_placed(self):
+        """A mesh-wrapped model keeps its params placed (TP specs or
+        replicated) — re-checked per call because quantize/dequantize swap
+        the params object; identity-cached so steady state pays nothing."""
+        placer = getattr(self.model, "ensure_placed", None)
+        if placer is not None:
+            placer()
+
+    def _run(self, fn, label, bucket, *args):
+        """Invoke a decode executable. On a mesh, the call takes the
+        context's run_lock and blocks until ready inside it: one
+        partitioned wave in flight per mesh, or concurrently-launched
+        collectives (this step vs the batcher's /predict dispatch)
+        interleave their rendezvous participants and deadlock XLA's CPU
+        runtime. Single-chip engines skip both."""
+        if self.mesh is None:
+            return self._timed(fn, label, bucket, *args)
+        with self.mesh.run_lock:
+            out = self._timed(fn, label, bucket, *args)
+            jax.block_until_ready(out)
+            return out
 
     def _timed(self, fn, label, bucket, *args):
         """Invoke a decode executable; the first call per label is the XLA
@@ -372,7 +447,12 @@ class DecodeEngine:
 
     def executable_counts(self):
         """{label: XLA cache size} for the compiled decode executables — the
-        hard recompile assertion (a retrace would grow a count past 1)."""
+        hard recompile assertion (a retrace would grow a count past 1).
+        On a mesh these are PER-SHARD sizes in the only honest sense: one
+        partitioned executable per label serves all chips, so a sharded
+        cache must still report 1 per label — a mesh engine that minted a
+        per-chip executable family would show up here as a count of
+        n_chips, and the smoke/tests pin it at 1."""
         out = {}
         with self._jit_lock:
             fns = [("decode_step", self._step_fn)] + \
@@ -398,6 +478,7 @@ class DecodeEngine:
             raise ValueError(
                 f"prompt of {n} tokens does not fit the cache "
                 f"(capacity {self.capacity}, needs room for >=1 new token)")
+        self._ensure_placed()
         L = self.prefill_bucket(n)
         padded = np.zeros((L,), np.int32)
         padded[:n] = ids
@@ -405,7 +486,7 @@ class DecodeEngine:
             fn = self._prefill_fns.get(L)
             if fn is None:
                 fn = self._prefill_fns[L] = self._build_prefill(L)
-        cache, nid, probs = self._timed(
+        cache, nid, probs = self._run(
             fn, f"decode_prefill:{L}", L, self.model.params,
             self.model.states, cache, np.int32(slot), padded, np.int32(n))
         return cache, int(nid), np.asarray(probs)
@@ -416,11 +497,12 @@ class DecodeEngine:
         cache rows are reset by the next prefill). Returns (cache,
         next_ids [slots] np.int32, probs [slots, vocab])."""
         ids = np.asarray(last_ids, np.int32).reshape(self.slots)
+        self._ensure_placed()
         with self._jit_lock:
             if self._step_fn is None:
                 self._step_fn = self._build_step()
             fn = self._step_fn
-        cache, nxt, probs = self._timed(
+        cache, nxt, probs = self._run(
             fn, "decode_step", "step", self.model.params, self.model.states,
             cache, ids)
         return cache, np.asarray(nxt), np.asarray(probs)

@@ -134,6 +134,14 @@ class DecodeScheduler:
                   fn=lambda: float(self.active_count()))
         reg.gauge("decode_queue_depth", "Generate requests awaiting a slot",
                   fn=lambda: float(self.depth()))
+        # PER-SHARD cache bytes: on a mesh the KV cache partitions its head
+        # axis across chips, and what admission/capacity must answer for is
+        # what ONE chip holds resident — the global figure would overstate
+        # per-chip pressure by n_model x (single-chip engines report the
+        # same number either way)
+        reg.gauge("decode_cache_mb",
+                  "KV-cache bytes resident PER SHARD (MB) for the live "
+                  "engine", fn=lambda: self.cache_mb())
         for c in (self.m_requests, self.m_tokens, self.m_shed,
                   self.m_expired, self.m_errors):
             c.inc(0)
@@ -264,7 +272,21 @@ class DecodeScheduler:
             "itl_ms": self.m_itl.percentiles(),
             "version": self._version,
             "prefill_buckets": buckets,
+            "cache_mb": self.cache_mb(),
         }
+
+    def cache_mb(self):
+        """PER-SHARD KV-cache megabytes of the live engine (0.0 before the
+        first deploy). Sharded caches divide each entry by its shard count,
+        so the gauge answers "what does one chip hold", matching the
+        per-chip HBM budget the capacity plane reasons about."""
+        eng = self._engine
+        if eng is None:
+            return 0.0
+        try:
+            return float(eng.cache_bytes(per_shard=True)) / 1e6
+        except Exception:
+            return 0.0
 
     # ------------------------------------------------------------- engines
     def engine_for(self, model):

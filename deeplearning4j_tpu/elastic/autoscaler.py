@@ -170,6 +170,13 @@ class AutoscaleController:
             "Pool replicas reported down/unroutable")
         self._g_size = self.registry.gauge(
             "autoscale_replicas", "Current serving pool size")
+        # pool size counts HANDLES: a mesh group (serving/mesh.py) is one
+        # ReplicaHandle no matter how many chips answer behind it, and
+        # min/max/step policy math runs on that count. Chips are the
+        # capacity view, published separately for operators/dashboards.
+        self._g_chips = self.registry.gauge(
+            "autoscale_chips",
+            "Accelerator chips behind the pool (sum of replica mesh_chips)")
         self._m_requests = self.registry.counter(
             "autoscale_requests_total",
             "Requests answered across the pool (mirrored replica deltas)")
@@ -235,7 +242,11 @@ class AutoscaleController:
         self._g_queue.set(queue_depth)
         self._g_breakers.set(float(open_breakers))
         self._g_down.set(float(len(down)))
+        # policy math (min/max/step, replicas_down) counts replica HANDLES;
+        # a mesh group stays 1 here even at 8 chips — chips is display only
         self._g_size.set(float(len(replicas)))
+        self._g_chips.set(float(sum(getattr(r, "chips", 1)
+                                    for r in replicas)))
         if requests:
             self._m_requests.inc(requests)
         if shed:
@@ -247,7 +258,8 @@ class AutoscaleController:
         for name in down:
             self._down_since.setdefault(name, now)
         return {"queue_depth": queue_depth, "down": down,
-                "breakers_open": open_breakers, "replicas": len(replicas)}
+                "breakers_open": open_breakers, "replicas": len(replicas),
+                "chips": sum(getattr(r, "chips", 1) for r in replicas)}
 
     # ---- decision + action -------------------------------------------------
     def _cooldown_ok(self):

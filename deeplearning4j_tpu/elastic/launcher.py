@@ -54,9 +54,12 @@ class ReplicaLauncher:
 
 class InProcessLauncher(ReplicaLauncher):
     """Threaded ServingServer replicas sharing one scan_dir; see module
-    docstring. `server_opts` pass through to every ServingServer;
-    `broker_factory` (zero-arg -> streaming.BrokerClient) enables the live
-    per-replica deploy subscription."""
+    docstring. `server_opts` pass through to every ServingServer —
+    including `mesh` (serving/mesh.py), so a launcher configured with
+    `server_opts={"mesh": {...}}` spawns MESH-GROUP replicas: each launch
+    is one server spanning N chips that registers in the fleet as ONE
+    ReplicaHandle. `broker_factory` (zero-arg -> streaming.BrokerClient)
+    enables the live per-replica deploy subscription."""
 
     def __init__(self, scan_dir=None, server_opts=None, max_replicas=8,
                  broker_factory=None, topic="registry_events",
@@ -212,12 +215,20 @@ class SubprocessLauncher(ReplicaLauncher):
     spawns `python -c <bootstrap>` that starts a ServingServer over the
     shared scan_dir and prints its port. Warm-up deploys go over HTTP
     (POST /deploy) since the subscriber lives in the child. Bounded by
-    `max_replicas` like every launcher."""
+    `max_replicas` like every launcher.
+
+    Mesh groups: `server_opts["mesh"]` is normalized to its JSON dict form
+    so it survives the argv hand-off; the child inherits the parent's env,
+    so set XLA_FLAGS=--xla_force_host_platform_device_count=N in the
+    parent when smoke-testing a CPU mesh."""
 
     def __init__(self, scan_dir, server_opts=None, max_replicas=4,
                  deploy_event=None, start_timeout_s=60.0):
         self.scan_dir = str(scan_dir)
         self.server_opts = dict(server_opts or {})
+        mesh = self.server_opts.get("mesh")
+        if mesh is not None and hasattr(mesh, "to_dict"):
+            self.server_opts["mesh"] = mesh.to_dict()
         self.max_replicas = int(max_replicas)
         self.last_deploy_event = deploy_event
         self.start_timeout_s = float(start_timeout_s)

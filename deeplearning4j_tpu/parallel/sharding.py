@@ -116,6 +116,67 @@ def param_shardings(params, mesh, rules: ShardingRules):
     return rebuild(params)
 
 
+def match_partition_rules(rules: ShardingRules, params):
+    """Pytree of PartitionSpecs matching `params`, resolved by regex search
+    over the '/'-joined leaf paths (the fmengine `match_partition_rules`
+    idiom, SNIPPETS.md [3]): first rule whose pattern matches wins, scalar
+    leaves are unpartitioned, and unmatched leaves fall back to replicated
+    P() — serving must never refuse a model because one exotic leaf has no
+    rule. Specs are trimmed to each leaf's rank."""
+    flat = _param_paths(params)
+
+    def assign(path, leaf):
+        ndim = getattr(leaf, "ndim", 0)
+        if ndim == 0:
+            return P()
+        spec = rules.spec_for(path, ndim)
+        if len(spec) > ndim:
+            spec = P(*spec[:ndim])
+        return spec
+
+    specs = {p: assign(p, l) for p, l in flat.items()}
+
+    def rebuild(tree, prefix=""):
+        if isinstance(tree, dict):
+            return {k: rebuild(v, f"{prefix}{k}/") for k, v in tree.items()}
+        if isinstance(tree, (list, tuple)):
+            vals = [rebuild(v, f"{prefix}{i}/") for i, v in enumerate(tree)]
+            return tuple(vals) if isinstance(tree, tuple) else vals
+        if tree is None:
+            return None
+        return specs[prefix[:-1]]
+    return rebuild(params)
+
+
+def spec_shards(mesh, spec):
+    """How many pieces `spec` splits a leaf into on `mesh` (product of the
+    mesh extents of every named axis in the spec)."""
+    n = 1
+    for axes in spec:
+        if axes is None:
+            continue
+        for a in ((axes,) if isinstance(axes, str) else tuple(axes)):
+            n *= int(mesh.shape[a])
+    return n
+
+
+def even_sharding(mesh, spec, shape):
+    """NamedSharding(mesh, spec) when every partitioned dim divides its mesh
+    extent evenly, else the replicated NamedSharding. Serving placement must
+    degrade to replication — not fail the dispatch — when a model's head
+    count or channel width doesn't divide the mesh axis."""
+    spec = P(*spec[:len(shape)])
+    for dim, axes in zip(shape, spec):
+        if axes is None:
+            continue
+        n = 1
+        for a in ((axes,) if isinstance(axes, str) else tuple(axes)):
+            n *= int(mesh.shape[a])
+        if n > 1 and int(dim) % n:
+            return NamedSharding(mesh, P())
+    return NamedSharding(mesh, spec)
+
+
 def _key_str(k):
     if hasattr(k, "key"):
         return str(k.key)

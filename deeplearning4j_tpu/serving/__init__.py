@@ -27,12 +27,20 @@ CompileTracker, ui/storage stats-tier routing), and every /predict is
 traced (predict -> admission/batch -> dispatch spans, exported as
 Chrome-trace JSON at /trace). The legacy `streaming.InferenceServer` is now
 a thin compatibility wrapper over it.
+
+`mesh=` puts the whole server on a device mesh (serving/mesh.py): the
+registry wraps every model in a `MeshDispatcher` so one /predict wave is
+answered by ONE executable call spanning all chips (batch split over the
+data axis, weights optionally tensor-parallel over the model axis, the
+decode KV cache head-sharded) — and the whole group registers in a
+FleetFrontend as ONE ReplicaHandle.
 """
 from .admission import (AdmissionQueue, DeadlineExceeded, RejectedError,
                         Request)
 from .batcher import DynamicBatcher, bucket_for
 from .canary import CanaryController
 from .frontend import FleetFrontend, RegistrySubscriber, ReplicaHandle
+from .mesh import MeshContext, MeshDispatcher, MeshServingConfig
 from .metrics import ServingMetrics
 from .registry import ModelRegistry, ModelVersion, NoModelDeployed
 from .server import ServingServer
@@ -41,4 +49,5 @@ __all__ = ["AdmissionQueue", "DeadlineExceeded", "RejectedError", "Request",
            "DynamicBatcher", "bucket_for", "ServingMetrics", "ModelRegistry",
            "ModelVersion", "NoModelDeployed", "ServingServer",
            "FleetFrontend", "RegistrySubscriber", "ReplicaHandle",
-           "CanaryController"]
+           "CanaryController", "MeshContext", "MeshDispatcher",
+           "MeshServingConfig"]

@@ -107,6 +107,12 @@ class ReplicaHandle:
         self.cohort = STABLE
         self.health = "unknown"        # healthy/degraded/unhealthy/down
         self.health_detail = None      # last /healthz body (or error string)
+        # chips behind this handle (learned from /healthz `mesh_chips`). A
+        # mesh group registers as ONE handle — one breaker, one cohort
+        # member, eject-all-or-none — so chips is DISPLAY/capacity info
+        # only: never count it in routing, the never-empty guard, or
+        # autoscaler min/max/step policy math, all of which count handles.
+        self.chips = 1
 
     def weight(self) -> float:
         """Routing weight from last-known health; the breaker gates
@@ -119,7 +125,7 @@ class ReplicaHandle:
     def to_dict(self):
         return {"name": self.name, "url": self.url, "cohort": self.cohort,
                 "health": self.health, "weight": self.weight(),
-                "routable": self.routable(),
+                "routable": self.routable(), "chips": self.chips,
                 "breaker": self.breaker.to_dict()}
 
 
@@ -279,6 +285,8 @@ class FleetFrontend(BackgroundHttpServer):
             if handle is None:
                 raise KeyError(f"unknown replica {name!r}")
             remaining = [r for r in self.replicas if r is not handle]
+            # never-empty counts HANDLES: one 8-chip mesh group alone in the
+            # pool is still "the last replica" and cannot be removed
             if not remaining:
                 raise ValueError("cannot remove the last replica")
             self.replicas = remaining
@@ -313,8 +321,11 @@ class FleetFrontend(BackgroundHttpServer):
         return probe
 
     def _probe_pool(self):
+        # `replicas` counts HANDLES (a mesh group is one), `chips` sums the
+        # accelerators behind them — capacity display for mixed pools
         routable = [r for r in self.replicas if r.routable()]
-        detail = {"replicas": len(self.replicas), "routable": len(routable)}
+        detail = {"replicas": len(self.replicas), "routable": len(routable),
+                  "chips": sum(r.chips for r in self.replicas)}
         if not routable:
             return UNHEALTHY, {**detail, "reason": "no routable replica"}
         if len(routable) < len(self.replicas):
@@ -351,6 +362,11 @@ class FleetFrontend(BackgroundHttpServer):
             replica.health = word if word in _RANK else \
                 (UNHEALTHY if code >= 500 else DEGRADED)
             replica.health_detail = body
+            if isinstance(body, dict):
+                try:
+                    replica.chips = max(1, int(body.get("mesh_chips") or 1))
+                except (TypeError, ValueError):
+                    replica.chips = 1
         _fan_out(replicas, sweep)
         return True
 

@@ -133,16 +133,32 @@ class ModelVersion:
 
 
 class ModelRegistry:
-    def __init__(self, scan_dir=None):
+    def __init__(self, scan_dir=None, adapter=None):
         self._versions = {}
         self._active = None           # version string
         self._history = []            # previously active versions, for rollback
         self._lock = threading.Lock()
         self._deploy_lock = threading.Lock()  # serializes deploy/rollback
+        # adapter: applied to every model at registration (idempotent) — the
+        # mesh-serving hook (serving/mesh.MeshContext.wrap) that makes every
+        # version dispatch sharded without the batcher/scheduler knowing
+        self.adapter = adapter
         self.scan_dir = str(scan_dir) if scan_dir is not None else None
         self.scan_errors = {}         # {filename: error} from directory scans
         if self.scan_dir is not None:
             self.scan()
+
+    def set_adapter(self, adapter, rewrap_existing=True):
+        """Install (or clear) the registration adapter; with
+        `rewrap_existing`, already-registered versions are re-adapted in
+        place so a mesh context installed after a scan_dir load still
+        covers every loaded model."""
+        self.adapter = adapter
+        if adapter is not None and rewrap_existing:
+            with self._lock:
+                for mv in self._versions.values():
+                    mv.model = adapter(mv.model)
+        return self
 
     # ---- persistent directory ---------------------------------------------
     def scan(self):
@@ -182,6 +198,8 @@ class ModelRegistry:
 
     # ---- registration -----------------------------------------------------
     def register(self, version, model, path=None, fmt=None, transform=None):
+        if self.adapter is not None:
+            model = self.adapter(model)
         with self._lock:
             if str(version) in self._versions:
                 raise ValueError(f"version {version!r} already registered")

@@ -68,12 +68,26 @@ class ServingServer(BackgroundHttpServer):
                  alert_interval_s=5.0, log_sinks=None,
                  seq_len_bucketing=True, decode=False, decode_slots=4,
                  decode_max_len=128, decode_queue_capacity=64,
-                 decode_max_new_tokens=32, quant_gate=None):
+                 decode_max_new_tokens=32, quant_gate=None, mesh=None):
         # scan_dir: persistent registry directory — every ModelSerializer zip
         # in it is loaded at startup and POST /deploy accepts any model name
         # from it (see ModelRegistry.scan / deploy-by-name)
         super().__init__(host=host, port=port)
-        self.registry = registry or ModelRegistry(scan_dir=scan_dir)
+        self.tracer = tracer if tracer is not None else Tracer(enabled=True)
+        # mesh-sharded serving (serving/mesh.py): every registered version is
+        # wrapped by the context's MeshDispatcher through the registry
+        # adapter, so the batcher's coalesced batch splits over the mesh data
+        # axis and TP-ruled weights span chips — this whole server stays ONE
+        # fleet replica (one ReplicaHandle, one breaker, one health probe)
+        self.mesh = None
+        if mesh is not None and mesh is not False:
+            from .mesh import MeshContext
+            self.mesh = MeshContext(mesh, tracer=self.tracer)
+        adapter = self.mesh.wrap if self.mesh is not None else None
+        self.registry = registry or ModelRegistry(scan_dir=scan_dir,
+                                                  adapter=adapter)
+        if adapter is not None and registry is not None:
+            self.registry.set_adapter(adapter)
         if model is not None:
             self.registry.register(version, model)
             self.registry.deploy(version)
@@ -81,7 +95,6 @@ class ServingServer(BackgroundHttpServer):
         # telemetry: per-server tracer (bounded buffer, exported at /trace),
         # XLA compile accounting + device-memory gauges in the same registry
         # the /metrics exposition renders
-        self.tracer = tracer if tracer is not None else Tracer(enabled=True)
         self.compile_tracker = CompileTracker(self.metrics.registry)
         register_device_memory_gauges(self.metrics.registry)
         self.metrics.registry.gauge(
@@ -117,6 +130,17 @@ class ServingServer(BackgroundHttpServer):
         self.health.register("admission", self._probe_admission)
         self.health.register("batcher", self._probe_batcher)
         self.health.register("registry", self._probe_registry)
+        if self.mesh is not None:
+            # the whole mesh group reports through THIS server's single
+            # health probe — the fleet ejects/serves it all-or-none
+            self.health.register("mesh", self._probe_mesh)
+            self.metrics.registry.gauge(
+                "mesh_dispatch_chips",
+                "Chips answering one mesh-sharded dispatch",
+                fn=lambda: float(self.mesh.chips))
+            self.metrics.registry.gauge(
+                "mesh_dispatches_total", "Mesh-routed batch dispatches",
+                fn=lambda: float(self.mesh.dispatches))
         rules = default_serving_rules() if alert_rules is None \
             else list(alert_rules)
         sinks = list(alert_sinks or [])
@@ -164,6 +188,14 @@ class ServingServer(BackgroundHttpServer):
         if not t.is_alive():
             return "unhealthy", {"reason": "batcher thread dead"}
         return "healthy", {}
+
+    def _probe_mesh(self):
+        import jax
+        d = self.mesh.describe()
+        if self.mesh.chips > len(jax.devices()):
+            return "unhealthy", {**d, "reason": "mesh larger than the "
+                                               "visible device set"}
+        return "healthy", d
 
     def _probe_registry(self):
         versions = self.registry.versions()
@@ -638,13 +670,19 @@ class ServingServer(BackgroundHttpServer):
         `health` always carries the raw healthy/degraded/unhealthy word.
         The HTTP layer answers 503 only when some component is unhealthy."""
         h = self.health.check()
-        return {"status": "ok" if h["status"] == "healthy" else h["status"],
-                "health": h["status"],
-                "components": h["components"],
-                "served": self.metrics.rows.get(),
-                "requests": self.metrics.requests.get(),
-                "queue_depth": self.queue.depth(),
-                "active_version": self.registry.active_version}
+        report = {
+            "status": "ok" if h["status"] == "healthy" else h["status"],
+            "health": h["status"],
+            "components": h["components"],
+            "served": self.metrics.rows.get(),
+            "requests": self.metrics.requests.get(),
+            "queue_depth": self.queue.depth(),
+            "active_version": self.registry.active_version}
+        if self.mesh is not None:
+            # surfaced so the fleet planes can display chip counts while
+            # still counting this whole group as ONE replica
+            report["mesh_chips"] = self.mesh.chips
+        return report
 
     def _snapshot(self):
         snap = self.metrics.snapshot(
@@ -653,6 +691,11 @@ class ServingServer(BackgroundHttpServer):
                           for v in self.registry.versions()})
         if self.decode is not None:
             snap["decode"] = self.decode.snapshot()
+        if self.mesh is not None:
+            # the JSON exposition is curated: mirror the mesh gauges here so
+            # scrapers that never speak Prometheus still see the chip count
+            snap["mesh_dispatch_chips"] = self.mesh.chips
+            snap["mesh_dispatches_total"] = self.mesh.dispatches
         return snap
 
     def _metrics_snapshot(self):

@@ -843,6 +843,94 @@ def test_gl014_repo_gate_quant_stays_narrow():
     assert report.violations == [], [str(v) for v in report.violations]
 
 
+HOT_SERVING = "deeplearning4j_tpu/serving/batcher.py"
+
+
+def test_gl015_detects_bare_placement_in_hot_path():
+    """A device_put with no sharding anywhere in its statement, and an
+    implicit jnp placement inside a dispatch-named function with no
+    sharding anywhere in the function, both fire in serving/."""
+    seeded = textwrap.dedent("""\
+    import jax
+    import jax.numpy as jnp
+
+    def _dispatch(model, batch, mask):
+        xb = jax.device_put(batch)
+        yb = jax.device_put(mask, jax.devices()[0])
+        zb = jnp.asarray(batch)
+        return model.output(xb, yb, zb)
+    """)
+    flagged = lint(seeded, rel_path=HOT_SERVING, rules=["GL015"])
+    assert [v.line for v in flagged] == [5, 6, 7], flagged
+    assert all(v.rule == "GL015" for v in flagged)
+
+
+def test_gl015_edges():
+    # placement under a *_sharding helper (the mesh dispatch idiom) is quiet
+    aware = textwrap.dedent("""\
+    import jax
+    import jax.numpy as jnp
+
+    def output(self, x):
+        xb = jax.device_put(x, self.mesh_context.batch_sharding(x.ndim))
+        return self.mesh_inner.output(xb)
+    """)
+    assert lint(aware, rel_path=HOT_SERVING, rules=["GL015"]) == []
+    # sharding-awareness is judged per STATEMENT: a tree_map whose sibling
+    # argument carries the shardings covers the lambda's bare device_put
+    treemap = textwrap.dedent("""\
+    import jax
+
+    def init_cache(self):
+        cache = self._cache_zeros()
+        return jax.tree_util.tree_map(
+            lambda leaf, s: jax.device_put(leaf, s), cache,
+            self.cache_shardings())
+    """)
+    assert lint(treemap, rel_path="deeplearning4j_tpu/decode/engine.py",
+                rules=["GL015"]) == []
+    # implicit jnp placement outside a dispatch-named function is quiet
+    cold = textwrap.dedent("""\
+    import jax.numpy as jnp
+
+    def summarize(rows):
+        return jnp.asarray(rows).mean()
+    """)
+    assert lint(cold, rel_path=HOT_SERVING, rules=["GL015"]) == []
+    # a dispatch-named fn that references a sharding ANYWHERE is judged
+    # sharding-aware (the conversion feeds a later constrained placement)
+    mixed = textwrap.dedent("""\
+    import jax
+    import jax.numpy as jnp
+
+    def prefill(self, ids):
+        a = jnp.asarray(ids)
+        return jax.device_put(a, self.mesh.cache_sharding(a.shape))
+    """)
+    assert lint(mixed, rel_path="deeplearning4j_tpu/decode/engine.py",
+                rules=["GL015"]) == []
+    # outside serving//decode/ the rule is scoped off entirely
+    seeded = textwrap.dedent("""\
+    import jax
+
+    def dispatch(x):
+        return jax.device_put(x)
+    """)
+    assert lint(seeded, rules=["GL015"]) == []
+    assert lint(seeded, rel_path="deeplearning4j_tpu/etl/prefetch.py",
+                rules=["GL015"]) == []
+
+
+def test_gl015_repo_dispatch_paths_are_clean():
+    """Satellite gate: the serving + decode subsystems obey their own rule
+    — every batch/cache placement flows through a sharding, zero GL015
+    findings, zero baselined remainders."""
+    report = Analyzer(rules=[get_rule("GL015")], root=str(REPO)).analyze_paths(
+        ["deeplearning4j_tpu", "tools"])
+    assert report.errors == []
+    assert report.violations == [], [str(v) for v in report.violations]
+
+
 # ---------------------------------------------------------------- baseline
 
 def test_baseline_round_trip_via_cli(tmp_path):
@@ -973,7 +1061,8 @@ def test_cli_rule_subset_and_list_rules():
         assert rule.id in proc.stdout and rule.rationale
     assert [r.id for r in all_rules()] == \
         ["GL001", "GL002", "GL003", "GL004", "GL005", "GL006", "GL007",
-         "GL008", "GL009", "GL010", "GL011", "GL012", "GL013", "GL014"]
+         "GL008", "GL009", "GL010", "GL011", "GL012", "GL013", "GL014",
+         "GL015"]
 
 
 def test_repo_gate_is_clean_and_fast():
