@@ -1,4 +1,4 @@
-"""GL001–GL015: the rule catalog (see RULES.md for the bug-history rationale).
+"""GL001–GL016: the rule catalog (see RULES.md for the bug-history rationale).
 
 Each rule is intra-file AST analysis with light import resolution: aliases
 from ``import x as y`` / ``from m import n as y`` are resolved so
@@ -1198,3 +1198,182 @@ class MeshReplicatedDispatchRule(Rule):
             if isinstance(sub, ast.arg) and cls._SHARDY.search(sub.arg):
                 return True
         return False
+
+
+# ---------------------------------------------------------------------------
+# GL016 — sampling-recompile-key
+# ---------------------------------------------------------------------------
+
+@register
+class SamplingRecompileKeyRule(Rule):
+    """Sampling params as jit static args or executable-cache-key parts."""
+
+    id = "GL016"
+    name = "sampling-recompile-key"
+    rationale = (
+        "Decode serves ONE step executable for every request mix; sampling "
+        "params (temperature / top_k / top_p / seed) ride as batch-shaped "
+        "array operands of that executable (decode/sampling.py). The "
+        "moment one of them becomes a `jax.jit` static argument or a "
+        "component of an executable-cache key, every novel value triggers "
+        "a fresh trace+compile in the serving hot path — seconds of XLA "
+        "per REQUEST, an unbounded executable cache, and a latency cliff "
+        "that only shows under parameter-diverse traffic (the single-user "
+        "smoke test never sees it). In serving/ and decode/, sampling "
+        "params must never be static args or cache-key components.")
+
+    #: the modules whose executables serve per-request traffic
+    HOT_PREFIXES = ("deeplearning4j_tpu/serving/",
+                    "deeplearning4j_tpu/decode/")
+    #: identifier shapes of per-request sampling knobs; matched on whole
+    #: underscore-separated words so `seed_bucket` hits but `reseed` and
+    #: `processed` don't
+    _SAMPLING = re.compile(
+        r"(^|_)(temperature|temp|top_k|topk|top_p|topp|seed|sampler|"
+        r"sampling)($|_)", re.IGNORECASE)
+    _JIT = ("jax.jit", "jax.pjit")
+    #: dict methods whose first argument is a lookup key
+    _KEYED = ("get", "setdefault", "pop")
+
+    def check(self, ctx):
+        if not ctx.rel_path.startswith(self.HOT_PREFIXES):
+            return
+        aliases = ctx.aliases
+        for node in ctx.nodes:
+            if isinstance(node, ast.Call):
+                if self._is_jit(node, aliases):
+                    yield from self._check_jit(ctx, node, aliases)
+                elif isinstance(node.func, ast.Attribute) \
+                        and node.func.attr in self._KEYED and node.args:
+                    hit = self._sampling_key(node.args[0])
+                    if hit:
+                        yield self.violation(
+                            ctx, node, self._key_msg(hit, node.func.attr))
+            elif isinstance(node, ast.Subscript):
+                hit = self._sampling_key(node.slice)
+                if hit:
+                    yield self.violation(
+                        ctx, node, self._key_msg(hit, "subscript"))
+
+    # -- jit static args -----------------------------------------------------
+    @classmethod
+    def _is_jit(cls, node, aliases):
+        """jax.jit(...) directly, or functools.partial(jax.jit, ...) as the
+        decorator spelling."""
+        qual = call_qual(node, aliases)
+        if qual in cls._JIT:
+            return True
+        return (qual == "functools.partial" and node.args
+                and qualname(node.args[0], aliases) in cls._JIT)
+
+    def _check_jit(self, ctx, node, aliases):
+        nums = []
+        for kw in node.keywords:
+            if kw.arg == "static_argnames":
+                for name in self._str_consts(kw.value):
+                    if self._SAMPLING.search(name):
+                        yield self.violation(
+                            ctx, node,
+                            f"static_argnames={name!r}: a sampling param as "
+                            "a jit static arg retraces the decode "
+                            "executable for every novel value — pass it as "
+                            "a batch-shaped array operand "
+                            "(sampling.batch_operands) instead")
+            elif kw.arg == "static_argnums":
+                nums = self._int_consts(kw.value)
+        if nums:
+            params = self._callee_params(ctx, node, aliases)
+            for i in nums:
+                if params and -len(params) <= i < len(params) \
+                        and self._SAMPLING.search(params[i]):
+                    yield self.violation(
+                        ctx, node,
+                        f"static_argnums includes `{params[i]}`: a sampling "
+                        "param as a jit static arg retraces the decode "
+                        "executable for every novel value — pass it as a "
+                        "batch-shaped array operand "
+                        "(sampling.batch_operands) instead")
+
+    @staticmethod
+    def _str_consts(node):
+        if isinstance(node, ast.Constant) and isinstance(node.value, str):
+            return [node.value]
+        if isinstance(node, (ast.Tuple, ast.List, ast.Set)):
+            return [e.value for e in node.elts
+                    if isinstance(e, ast.Constant)
+                    and isinstance(e.value, str)]
+        return []
+
+    @staticmethod
+    def _int_consts(node):
+        if isinstance(node, ast.Constant) and isinstance(node.value, int):
+            return [node.value]
+        if isinstance(node, (ast.Tuple, ast.List, ast.Set)):
+            return [e.value for e in node.elts
+                    if isinstance(e, ast.Constant)
+                    and isinstance(e.value, int)]
+        return []
+
+    @classmethod
+    def _callee_params(cls, ctx, node, aliases):
+        """Positional param names of the function being jitted, where a
+        shallow look can resolve them: an inline lambda, a module-level def
+        named by the first argument, or — for the decorator spelling — the
+        decorated function itself."""
+        callee = None
+        for arg in node.args:
+            if qualname(arg, aliases) in cls._JIT:
+                continue                    # partial(jax.jit, ...)'s target
+            callee = arg
+            break
+        if isinstance(callee, ast.Lambda):
+            return [a.arg for a in callee.args.args]
+        if isinstance(callee, ast.Name):
+            for n in ctx.nodes:
+                if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef)) \
+                        and n.name == callee.id:
+                    return [a.arg for a in n.args.args]
+            return None
+        fn = enclosing_function(ctx, node)
+        if fn is not None and any(
+                node is d or any(node is w for w in ast.walk(d))
+                for d in fn.decorator_list):
+            return [a.arg for a in fn.args.args]
+        return None
+
+    # -- cache keys ----------------------------------------------------------
+    @classmethod
+    def _sampling_key(cls, expr):
+        """A sampling value used AS a lookup key: the bare Name/Attribute
+        itself (`fns[cfg.seed]`), or anywhere inside a composite
+        Tuple/f-string key (`fns[(L, temperature)]`, `fns[f"s:{seed}"]`).
+        Two shapes deliberately stay quiet: string CONSTANTS
+        (`operands["temperature"]` is the legitimate operand-dict read —
+        the field NAME is fixed, the values live in the array), and
+        arithmetic index expressions (`sorted_p[top_k - 1]` is array math
+        on a filtered distribution, not an executable-cache key)."""
+        if isinstance(expr, (ast.Name, ast.Attribute)):
+            return cls._ident_match(expr)
+        if isinstance(expr, (ast.Tuple, ast.JoinedStr)):
+            for sub in ast.walk(expr):
+                hit = cls._ident_match(sub)
+                if hit:
+                    return hit
+        return None
+
+    @classmethod
+    def _ident_match(cls, node):
+        if isinstance(node, ast.Name) and cls._SAMPLING.search(node.id):
+            return node.id
+        if isinstance(node, ast.Attribute) \
+                and cls._SAMPLING.search(node.attr):
+            return node.attr
+        return None
+
+    @staticmethod
+    def _key_msg(ident, via):
+        return (f"sampling param `{ident}` flows into a lookup key "
+                f"({via}): keyed executables/caches grow one entry per "
+                "novel value and each miss is a fresh trace+compile in "
+                "the decode hot path — key by SHAPE (bucket, window, "
+                "slot count) and pass sampling values as array operands")

@@ -1,6 +1,6 @@
 """DecodeEngine: fixed-shape KV-cache decode executables for the nn types.
 
-One engine serves one model with exactly two executable families:
+One engine serves one model with three executable families:
 
 - ``step``: ONE compiled function of fixed shape — [slots] token ids in,
   [slots] next ids out — that advances EVERY in-flight request by one token.
@@ -20,11 +20,32 @@ One engine serves one model with exactly two executable families:
   and the recurrent final carries land in the slot's carry rows. Pad
   positions write garbage K/V beyond `length`; the length mask keeps every
   later step from ever attending to them.
+- ``verify`` (speculative decoding, decode/speculative.py): one compiled
+  function per window size W — appends a W-token window at a dynamic
+  `start` offset of one slot and returns ALL W next-token distributions in
+  one batched pass (prefill-shaped work: it spends the compute the
+  HBM-bound step leaves idle). Rollback after the accept decision is a
+  host-side length reset — which is why verify requires rewind-free state
+  (attention-only models; LSTM carries cannot rewind).
+
+Both legs emit SAMPLED token ids (decode/sampling.py): temperature /
+top-k / top-p / seed arrive as batch-shaped ARRAY OPERANDS, with
+temperature <= 0 short-circuiting to argmax in-trace, so greedy and
+creative requests co-batch in the same executable and per-request sampling
+params never become recompile keys (graftlint GL016).
 
 The cache is a plain pytree ``{"lengths": int32[slots], "layers": {name:
-entry}}`` threaded functionally through both executables and DONATED, so
+entry}}`` threaded functionally through the executables and DONATED, so
 steady state re-uses the cache buffers in place instead of allocating a
-fresh multi-MB cache per token.
+fresh multi-MB cache per token. With ``paged=True`` the attention entries
+become a shared BLOCK POOL ``[num_blocks, block_size, H, Dh]`` addressed
+through a ``[slots, max_blocks]`` int32 block-table operand
+(decode/paged.py): appends scatter into (table[pos//bs], pos%bs), the
+attention gathers the slot's blocks back into contiguous rows
+(kernels.flash_attention.flash_decode_paged), and capacity is whatever the
+scheduler's allocator backs — token-for-token equal to the slab layout
+(parity-tested), with the table replicated on a mesh while the pool keeps
+head-sharding.
 
 Decode runs in the model's param dtype (no mixed-precision cast): decode is
 bound by streaming cache bytes, not MXU throughput, and greedy parity with
@@ -48,6 +69,7 @@ from ..nn.layers.recurrent import (GravesBidirectionalLSTMModule,
                                    SelfAttentionLayerModule, _BaseLSTMModule)
 from ..telemetry.xla import record_jit_compile
 from ..util.time_source import monotonic_s
+from . import sampling as _sampling
 
 
 class DecodeUnsupported(TypeError):
@@ -164,10 +186,33 @@ def build_plan(model):
 
 class DecodeEngine:
     def __init__(self, model, *, slots=4, max_len=128, compile_tracker=None,
-                 registry=None):
+                 registry=None, paged=False, block_size=16, num_blocks=None):
         self.model = model
         self.slots = int(slots)
         self.capacity = int(max_len)
+        self.paged = bool(paged)
+        self.block_size = int(block_size)
+        if self.paged:
+            if self.block_size < 1 or (self.block_size
+                                       & (self.block_size - 1)):
+                raise ValueError(f"block_size must be a power of two, got "
+                                 f"{self.block_size}")
+            # capacity in whole blocks: the table addresses nothing finer
+            bs = self.block_size
+            self.capacity = -(-self.capacity // bs) * bs
+            self.max_blocks = self.capacity // bs
+            # default pool: every slot fully backed, +1 for the scratch
+            # block — byte-parity with the slab, so paged-vs-slab parity
+            # tests compare equal capacity (the scheduler passes a smaller
+            # pool to actually oversubscribe)
+            self.num_blocks = (self.slots * self.max_blocks + 1
+                               if num_blocks is None else int(num_blocks))
+            if self.num_blocks < 2:
+                raise ValueError("paged cache needs >= 2 blocks "
+                                 "(block 0 is scratch)")
+        else:
+            self.max_blocks = 0
+            self.num_blocks = 0
         self.nodes, self.input_name, self.output_name, self.vocab = \
             build_plan(model)
         if model.params is None:
@@ -189,8 +234,13 @@ class DecodeEngine:
         self._cache_shardings = None        # lazily built pytree
         self._step_fn = None
         self._prefill_fns = {}              # length bucket -> jitted fn
+        self._verify_fns = {}               # window size W -> jitted fn
         self._compiled = set()              # labels whose first call was timed
         self._jit_lock = threading.Lock()
+        # default (greedy) sampling operands, built once: callers that never
+        # sample pay zero per-call operand construction
+        self._greedy_step_ops = _sampling.batch_operands(self.slots)
+        self._greedy_slot_ops = _sampling.slot_operands(None, 0)
 
     # ------------------------------------------------------------ cache
     def _cache_zeros(self):
@@ -204,7 +254,13 @@ class DecodeEngine:
             if isinstance(m, SelfAttentionLayerModule):
                 H = int(m.conf.n_heads)
                 Dh = int(m.conf.n_out) // H
-                shape = (self.slots, self.capacity, H, Dh)
+                # paged: one shared pool per layer instead of per-slot rows;
+                # [N, bs, H, Dh] keeps the head axis at index 2, so the mesh
+                # cache_sharding rule (4-D -> shard axis 2) head-shards the
+                # pool exactly as it does the slab
+                shape = ((self.num_blocks, self.block_size, H, Dh)
+                         if self.paged
+                         else (self.slots, self.capacity, H, Dh))
                 layers[node.name] = {"k": jnp.zeros(shape, self._dtype),
                                      "v": jnp.zeros(shape, self._dtype)}
             elif isinstance(m, _BaseLSTMModule):
@@ -252,11 +308,30 @@ class DecodeEngine:
         return total
 
     # ------------------------------------------------------------ walks
-    def _walk_prefill(self, params, states, x0, mask, cache, slot, length):
+    def _paged_append_seq(self, entry, t, row):
+        """Scatter a [L, H, Dh] token sequence into the pool along `row`
+        (the slot's table row): the L positions reshape into L/bs chunks of
+        one block each, landing at the row's physical block ids. Pad chunks
+        of a prefill bucket address block 0 (scratch) — over-length writes
+        land where nobody reads instead of needing in-trace bounds checks."""
+        bs = self.block_size
+        L = t.shape[0]
+        chunks = -(-L // bs)
+        pad = chunks * bs - L
+        if pad:
+            t = jnp.pad(t, ((0, pad), (0, 0), (0, 0)))
+        tc = t.reshape(chunks, bs, t.shape[1], t.shape[2])
+        return entry.at[row[:chunks]].set(tc.astype(entry.dtype))
+
+    def _walk_prefill(self, params, states, x0, mask, cache, slot, length,
+                      table=None):
         """Full-sequence forward over the plan, capturing each stateful
-        layer's K/V (resp. final carry) into `slot`'s cache rows."""
+        layer's K/V (resp. final carry) into `slot`'s cache rows — in paged
+        mode, into the pool blocks of `slot`'s table row."""
         acts = {self.input_name: x0}
         layers = dict(cache["layers"])
+        if table is not None:
+            row = lax.dynamic_index_in_dim(table, slot, 0, keepdims=False)
         for node in self.nodes:
             if node.kind == "input":
                 continue
@@ -272,14 +347,19 @@ class DecodeEngine:
                 out = m.attend(q, k, v, mask)
                 y = m.finish(p, out, mask)
                 entry = layers[node.name]
-                z = jnp.zeros((), slot.dtype)   # match the traced slot's
-                layers[node.name] = {           # index dtype under x64
-                    "k": lax.dynamic_update_slice(
-                        entry["k"], k.astype(entry["k"].dtype),
-                        (slot, z, z, z)),
-                    "v": lax.dynamic_update_slice(
-                        entry["v"], v.astype(entry["v"].dtype),
-                        (slot, z, z, z))}
+                if table is not None:
+                    layers[node.name] = {
+                        "k": self._paged_append_seq(entry["k"], k[0], row),
+                        "v": self._paged_append_seq(entry["v"], v[0], row)}
+                else:
+                    z = jnp.zeros((), slot.dtype)  # match the traced slot's
+                    layers[node.name] = {          # index dtype under x64
+                        "k": lax.dynamic_update_slice(
+                            entry["k"], k.astype(entry["k"].dtype),
+                            (slot, z, z, z)),
+                        "v": lax.dynamic_update_slice(
+                            entry["v"], v.astype(entry["v"].dtype),
+                            (slot, z, z, z))}
             elif isinstance(m, _BaseLSTMModule):
                 n_out = int(m.conf.n_out)
                 zeros = (jnp.zeros((1, n_out), self._dtype),
@@ -301,13 +381,22 @@ class DecodeEngine:
             acts[node.name] = y
         return acts[self.output_name], layers
 
-    def _walk_step(self, params, states, x0, cache, pos, kv_valid):
+    def _walk_step(self, params, states, x0, cache, pos, kv_valid,
+                   table=None):
         """[slots, 1, f] single-token forward against the cache. `pos` is
         the per-slot append position (clamped), `kv_valid` the number of
         valid cache entries including the appended token."""
-        from ..kernels import flash_decode
+        from ..kernels import flash_decode, flash_decode_paged
         acts = {self.input_name: x0}
         layers = dict(cache["layers"])
+        if table is not None:
+            bs = self.block_size
+            # physical (block, offset) of each slot's append position; an
+            # unallocated logical block maps to 0 = scratch, so a slot the
+            # scheduler hasn't backed writes where nobody reads
+            blk = jnp.take_along_axis(table, (pos // bs)[:, None],
+                                      axis=1)[:, 0]
+            off = pos % bs
         for node in self.nodes:
             if node.kind == "input":
                 continue
@@ -321,16 +410,27 @@ class DecodeEngine:
             if isinstance(m, SelfAttentionLayerModule):
                 q, kt, vt = m.project_qkv(p, x)           # [S, 1, H, Dh]
                 entry = layers[node.name]
-                append = jax.vmap(
-                    lambda row, t, at: lax.dynamic_update_slice(
-                        row, t, (at, jnp.zeros((), at.dtype),
-                                 jnp.zeros((), at.dtype))))
-                nk = append(entry["k"], kt.astype(entry["k"].dtype), pos)
-                nv = append(entry["v"], vt.astype(entry["v"].dtype), pos)
-                layers[node.name] = {"k": nk, "v": nv}
-                out = flash_decode(q, nk, nv, kv_valid,
-                                   use_pallas=getattr(m.conf, "use_pallas",
-                                                      False))
+                if table is not None:
+                    nk = entry["k"].at[blk, off].set(
+                        kt[:, 0].astype(entry["k"].dtype))
+                    nv = entry["v"].at[blk, off].set(
+                        vt[:, 0].astype(entry["v"].dtype))
+                    layers[node.name] = {"k": nk, "v": nv}
+                    out = flash_decode_paged(
+                        q, nk, nv, table, kv_valid,
+                        use_pallas=getattr(m.conf, "use_pallas", False))
+                else:
+                    append = jax.vmap(
+                        lambda row, t, at: lax.dynamic_update_slice(
+                            row, t, (at, jnp.zeros((), at.dtype),
+                                     jnp.zeros((), at.dtype))))
+                    nk = append(entry["k"], kt.astype(entry["k"].dtype), pos)
+                    nv = append(entry["v"], vt.astype(entry["v"].dtype), pos)
+                    layers[node.name] = {"k": nk, "v": nv}
+                    out = flash_decode(q, nk, nv, kv_valid,
+                                       use_pallas=getattr(m.conf,
+                                                          "use_pallas",
+                                                          False))
                 y = m.finish(p, out.astype(x.dtype), None)
             elif isinstance(m, _BaseLSTMModule):
                 entry = layers[node.name]
@@ -344,14 +444,73 @@ class DecodeEngine:
             acts[node.name] = y
         return acts[self.output_name], layers
 
+    @staticmethod
+    def _verify_attend(q, k, v, start):
+        """[1, W, H, Dh] window queries vs one slot's full [1, C, H, Dh]
+        cache row, causal against GLOBAL positions: query i (at position
+        start+i) sees keys [0, start+i]. Cache entries beyond start+W hold
+        stale garbage from longer rolled-back windows — causally masked, so
+        rollback never has to zero them. W is tiny (K+1 draft tokens), so
+        the [H, W, C] score tile is reference-einsum territory; a Mosaic
+        flash variant with a query offset is the rig follow-up."""
+        W, C = q.shape[1], k.shape[1]
+        scale = 1.0 / float(np.sqrt(q.shape[-1]))
+        qpos = start + jnp.arange(W, dtype=jnp.int32)
+        kpos = jnp.arange(C, dtype=jnp.int32)
+        mask = kpos[None, :] <= qpos[:, None]                # [W, C]
+        s = jnp.einsum("bqhd,bkhd->bhqk", q.astype(jnp.float32),
+                       k.astype(jnp.float32)) * scale
+        s = jnp.where(mask[None, None], s, -1e30)
+        p = jax.nn.softmax(s, axis=-1)
+        out = jnp.einsum("bhqk,bkhd->bqhd", p, v.astype(jnp.float32))
+        return out.astype(q.dtype)
+
+    def _walk_verify(self, params, states, x0, cache, slot, start):
+        """[1, W, f] window forward for speculative verify: each attention
+        layer appends the window's K/V at `slot` row offset `start` and
+        attends the window against the whole row. Attention-only by
+        construction — `verify()` rejects recurrent plans, because rollback
+        is a host-side length reset and carries cannot rewind."""
+        acts = {self.input_name: x0}
+        layers = dict(cache["layers"])
+        for node in self.nodes:
+            if node.kind == "input":
+                continue
+            if node.kind == "vertex":
+                acts[node.name] = node.vertex.apply(
+                    [acts[i] for i in node.inputs])
+                continue
+            m = node.module
+            p, s = params[node.name], states[node.name]
+            x = acts[node.inputs[0]]
+            if isinstance(m, SelfAttentionLayerModule):
+                q, k, v = m.project_qkv(p, x)             # [1, W, H, Dh]
+                entry = layers[node.name]
+                z = jnp.zeros((), slot.dtype)
+                st = jnp.asarray(start, slot.dtype)
+                nk = lax.dynamic_update_slice(
+                    entry["k"], k.astype(entry["k"].dtype), (slot, st, z, z))
+                nv = lax.dynamic_update_slice(
+                    entry["v"], v.astype(entry["v"].dtype), (slot, st, z, z))
+                layers[node.name] = {"k": nk, "v": nv}
+                krow = lax.dynamic_index_in_dim(nk, slot, 0, keepdims=True)
+                vrow = lax.dynamic_index_in_dim(nv, slot, 0, keepdims=True)
+                out = self._verify_attend(q, krow, vrow, start)
+                y = m.finish(p, out.astype(x.dtype), None)
+            else:
+                y = m.forward(p, s, x, train=False, rng=None)[0]
+            acts[node.name] = y
+        return acts[self.output_name], layers
+
     # ------------------------------------------------------- executables
     def _one_hot(self, ids):
         return jax.nn.one_hot(ids, self.vocab, dtype=self._dtype)
 
     def _build_step(self):
         C = self.capacity
+        paged = self.paged
 
-        def step_fn(params, states, cache, ids):
+        def step_fn(params, states, cache, ids, samp, table):
             # int8 serving weights: decode executables consume the narrow
             # codes too; the fused dequant is the same one output() traces
             params = self.model._dequant_params(params)
@@ -359,45 +518,67 @@ class DecodeEngine:
             pos = jnp.clip(lengths, 0, C - 1)
             x0 = self._one_hot(ids[:, None])              # [S, 1, V]
             y, layers = self._walk_step(params, states, x0, cache,
-                                        pos, pos + 1)
+                                        pos, pos + 1,
+                                        table=table if paged else None)
             probs = y[:, -1].astype(jnp.float32)          # [S, V]
             new_cache = {"lengths": jnp.minimum(lengths + 1, C),
                          "layers": layers}
-            return new_cache, jnp.argmax(probs, axis=-1).astype(jnp.int32), \
-                probs
+            return new_cache, _sampling.sample_tokens(probs, samp), probs
 
         return jax.jit(step_fn, donate_argnums=(2,), **self._jit_sharding())
 
     def _build_prefill(self, L):
-        def prefill_fn(params, states, cache, slot, ids, length):
+        paged = self.paged
+
+        def prefill_fn(params, states, cache, slot, ids, length, samp,
+                       table):
             params = self.model._dequant_params(params)
             x0 = self._one_hot(ids[None, :])              # [1, L, V]
             valid = (jnp.arange(L, dtype=jnp.int32)
                      < length).astype(self._dtype)[None]  # [1, L]
             y, layers = self._walk_prefill(params, states, x0, valid,
-                                           cache, slot, length)
+                                           cache, slot, length,
+                                           table=table if paged else None)
             z = jnp.zeros((), length.dtype)
             probs = lax.dynamic_slice(
                 y, (z, length - 1, z), (1, 1, self.vocab))[0, 0]
             probs = probs.astype(jnp.float32)
             new_cache = {"lengths": cache["lengths"].at[slot].set(length),
                          "layers": layers}
-            return new_cache, jnp.argmax(probs).astype(jnp.int32), probs
+            return new_cache, _sampling.sample_tokens(probs[None],
+                                                      samp)[0], probs
 
         return jax.jit(prefill_fn, donate_argnums=(2,),
                        **self._jit_sharding())
 
-    def _jit_sharding(self):
+    def _build_verify(self, W):
+        def verify_fn(params, states, cache, slot, ids, start):
+            params = self.model._dequant_params(params)
+            x0 = self._one_hot(ids[None, :])              # [1, W, V]
+            y, layers = self._walk_verify(params, states, x0, cache,
+                                          slot, start)
+            probs = y[0].astype(jnp.float32)              # [W, V]
+            # lengths unchanged: the accept decision is host-side, and the
+            # host commits the accepted length via set_length afterwards
+            new_cache = {"lengths": cache["lengths"], "layers": layers}
+            return new_cache, probs
+
+        return jax.jit(verify_fn, donate_argnums=(2,),
+                       **self._jit_sharding(n_repl=1))
+
+    def _jit_sharding(self, n_repl=2):
         """Extra jit kwargs on a mesh: pin the output cache to the SAME
         head-sharded placement as the donated input cache, so GSPMD's
         propagation can never pick a layout that breaks buffer donation —
         the zero-fresh-allocation steady state (GL011's sibling invariant)
         holds sharded exactly as it does on one chip. Token ids and probs
-        replicate (they're host-read every step)."""
+        replicate (they're host-read every step); `n_repl` is how many such
+        trailing outputs the executable returns."""
         if self.mesh is None:
             return {}
         repl = self.mesh.cache_sharding(())     # replicated NamedSharding
-        return {"out_shardings": (self.cache_shardings(), repl, repl)}
+        return {"out_shardings":
+                (self.cache_shardings(),) + (repl,) * n_repl}
 
     def _ensure_placed(self):
         """A mesh-wrapped model keeps its params placed (TP specs or
@@ -457,7 +638,9 @@ class DecodeEngine:
         with self._jit_lock:
             fns = [("decode_step", self._step_fn)] + \
                 [(f"decode_prefill:{L}", f)
-                 for L, f in sorted(self._prefill_fns.items())]
+                 for L, f in sorted(self._prefill_fns.items())] + \
+                [(f"decode_verify:{W}", f)
+                 for W, f in sorted(self._verify_fns.items())]
         for label, fn in fns:
             if fn is None:
                 continue
@@ -467,9 +650,36 @@ class DecodeEngine:
         return out
 
     # ------------------------------------------------------------- api
-    def prefill(self, cache, slot, prompt_ids):
+    def full_table(self, slots=None):
+        """Fully-provisioned block table (paged mode): slot s owns blocks
+        [1 + s*max_blocks, ...) contiguously. This is the static layout
+        engine-level callers (generate, warmup, parity tests) use — the
+        scheduler builds real tables block-by-block from its BlockPool.
+        Requires the default full-size pool."""
+        if not self.paged:
+            raise ValueError("full_table() is paged-mode only")
+        n = self.slots if slots is None else int(slots)
+        nb = self.max_blocks
+        table = np.zeros((self.slots, nb), np.int32)
+        for s in range(min(n, self.slots)):
+            want = 1 + s * nb + np.arange(nb, dtype=np.int32)
+            # a smaller-than-default pool can't back every slot: leave the
+            # overflow on scratch (warmup tolerates garbage K/V)
+            table[s] = np.where(want < self.num_blocks, want, 0)
+        return table
+
+    def _step_operands(self, sampling):
+        return self._greedy_step_ops if sampling is None else sampling
+
+    def prefill(self, cache, slot, prompt_ids, sampling=None, step_index=0,
+                table=None):
         """Run `prompt_ids` (python ints / 1-D array) into cache slot `slot`;
-        returns (cache, first generated id, last-position probs [vocab])."""
+        returns (cache, first generated id, last-position probs [vocab]).
+
+        `sampling`: a SamplerConfig (greedy when None); `step_index` is the
+        fold_in counter of the emitted token — 0 on a fresh admission,
+        len(partial) on a post-preemption re-prefill. `table`: the paged
+        block table (defaults to the static full table)."""
         ids = np.asarray(prompt_ids, np.int32).reshape(-1)
         n = ids.shape[0]
         if n < 1:
@@ -482,30 +692,125 @@ class DecodeEngine:
         L = self.prefill_bucket(n)
         padded = np.zeros((L,), np.int32)
         padded[:n] = ids
+        if sampling is None and step_index == 0:
+            samp = self._greedy_slot_ops
+        else:
+            samp = _sampling.slot_operands(sampling, step_index)
+        if self.paged and table is None:
+            table = self.full_table()
         with self._jit_lock:
             fn = self._prefill_fns.get(L)
             if fn is None:
                 fn = self._prefill_fns[L] = self._build_prefill(L)
         cache, nid, probs = self._run(
             fn, f"decode_prefill:{L}", L, self.model.params,
-            self.model.states, cache, np.int32(slot), padded, np.int32(n))
+            self.model.states, cache, np.int32(slot), padded, np.int32(n),
+            samp, table if self.paged else None)
         return cache, int(nid), np.asarray(probs)
 
-    def step(self, cache, last_ids):
+    def step(self, cache, last_ids, sampling=None, table=None):
         """Advance every slot one token. `last_ids`: [slots] int token ids
         (inactive slots may carry any id; their outputs are ignored and their
-        cache rows are reset by the next prefill). Returns (cache,
-        next_ids [slots] np.int32, probs [slots, vocab])."""
+        cache rows are reset by the next prefill). `sampling`: the operand
+        dict from sampling.batch_operands (greedy when None — per-request
+        sampling params are ARRAY operands here, never jit keys). Returns
+        (cache, next_ids [slots] np.int32, probs [slots, vocab])."""
         ids = np.asarray(last_ids, np.int32).reshape(self.slots)
         self._ensure_placed()
+        if self.paged and table is None:
+            table = self.full_table()
         with self._jit_lock:
             if self._step_fn is None:
                 self._step_fn = self._build_step()
             fn = self._step_fn
         cache, nxt, probs = self._run(
             fn, "decode_step", "step", self.model.params, self.model.states,
-            cache, ids)
+            cache, ids, self._step_operands(sampling),
+            table if self.paged else None)
         return cache, np.asarray(nxt), np.asarray(probs)
+
+    def has_recurrent(self):
+        return any(node.kind == "layer"
+                   and isinstance(node.module, _BaseLSTMModule)
+                   for node in self.nodes)
+
+    def verify(self, cache, slot, tokens, start):
+        """Speculative verify: append the W-token window `tokens` at row
+        offset `start` of `slot` and return (cache, probs [W, vocab]) — the
+        next-token distribution AFTER each window position, all W in ONE
+        batched pass. The caller owns the accept decision and commits the
+        surviving length via `set_length` (rollback = not advancing it).
+        One executable per W; attention-only, slab-layout only."""
+        if self.paged:
+            raise DecodeUnsupported(
+                "speculative verify runs on the slab layout (the paged "
+                "scheduler path and the verify window are separate tiers)")
+        if self.has_recurrent():
+            raise DecodeUnsupported(
+                "verify needs rewind-free state: recurrent carries cannot "
+                "roll back to `start` after a rejected draft")
+        ids = np.asarray(tokens, np.int32).reshape(-1)
+        W = ids.shape[0]
+        if W < 1:
+            raise ValueError("empty verify window")
+        if int(start) + W > self.capacity:
+            raise ValueError(
+                f"verify window [{int(start)}, {int(start) + W}) exceeds "
+                f"capacity {self.capacity}")
+        self._ensure_placed()
+        with self._jit_lock:
+            fn = self._verify_fns.get(W)
+            if fn is None:
+                fn = self._verify_fns[W] = self._build_verify(W)
+        cache, probs = self._run(
+            fn, f"decode_verify:{W}", W, self.model.params,
+            self.model.states, cache, np.int32(slot), ids, np.int32(start))
+        return cache, np.asarray(probs)
+
+    def set_length(self, cache, slot, n):
+        """Host-side length commit for `slot` (the speculative accept /
+        rollback primitive: cache rows beyond the new length become dead
+        weight the causal mask hides)."""
+        lengths = np.asarray(cache["lengths"]).copy()
+        lengths[int(slot)] = int(n)
+        out = dict(cache)
+        if self.mesh is not None:
+            out["lengths"] = jax.device_put(
+                jnp.asarray(lengths), self.cache_shardings()["lengths"])
+        else:
+            out["lengths"] = jnp.asarray(lengths)
+        return out
+
+    def carry_snapshot(self, cache):
+        """Host copy of the recurrent carries + lengths — tiny ([slots,
+        n_out] per LSTM layer, no K/V. The speculative engine snapshots a
+        recurrent DRAFT before proposing and restores on rollback; attention
+        entries don't need it (rollback is a length reset)."""
+        snap = {"lengths": np.asarray(cache["lengths"]).copy(), "layers": {}}
+        for name, entry in cache["layers"].items():
+            if "h" in entry:
+                snap["layers"][name] = {k: np.asarray(v).copy()
+                                        for k, v in entry.items()}
+        return snap
+
+    def carry_restore(self, cache, snap):
+        """Rewind the recurrent carries (and lengths) to a snapshot."""
+        layers = dict(cache["layers"])
+        shardings = self.cache_shardings() if self.mesh is not None else None
+        for name, entry in snap["layers"].items():
+            if shardings is not None:
+                layers[name] = {
+                    k: jax.device_put(jnp.asarray(v),
+                                      shardings["layers"][name][k])
+                    for k, v in entry.items()}
+            else:
+                layers[name] = {k: jnp.asarray(v)
+                                for k, v in entry.items()}
+        out = {"lengths": jnp.asarray(snap["lengths"]), "layers": layers}
+        if shardings is not None:
+            out["lengths"] = jax.device_put(jnp.asarray(snap["lengths"]),
+                                            shardings["lengths"])
+        return out
 
     def warmup(self, buckets=()):
         """Compile the step and the given prefill buckets on a scratch cache
@@ -519,21 +824,31 @@ class DecodeEngine:
         cache, _, _ = self.step(cache, np.zeros((self.slots,), np.int32))
         return self
 
-    def generate(self, prompt_ids, max_new_tokens=20, stop_id=None):
-        """Single-request greedy decode on slot 0 (the host loop behind
-        `network.generate`); returns the list of generated token ids."""
+    def generate(self, prompt_ids, max_new_tokens=20, stop_id=None,
+                 sampler=None):
+        """Single-request decode on slot 0 (the host loop behind
+        `network.generate`); greedy unless `sampler` (a SamplerConfig)
+        says otherwise. Returns the list of generated token ids."""
         if int(max_new_tokens) < 1:
             # same contract as DecodeScheduler.submit: the prefill always
             # emits one token, so 0 is unservable, not "empty result"
             raise ValueError("max_new_tokens must be >= 1")
         cache = self.init_cache()
-        cache, nid, _ = self.prefill(cache, 0, prompt_ids)
+        table = self.full_table() if self.paged else None
+        cache, nid, _ = self.prefill(cache, 0, prompt_ids, sampling=sampler,
+                                     table=table)
         out = [nid]
         ids = np.zeros((self.slots,), np.int32)
         while len(out) < int(max_new_tokens) and out[-1] != stop_id \
                 and len(np.asarray(prompt_ids).reshape(-1)) + len(out) \
                 < self.capacity:
             ids[0] = out[-1]
-            cache, nxt, _ = self.step(cache, ids)
+            samp = None
+            if sampler is not None:
+                # fold_in counter = index of the token being emitted
+                samp = _sampling.batch_operands(
+                    self.slots, {0: sampler}, {0: len(out)})
+            cache, nxt, _ = self.step(cache, ids, sampling=samp,
+                                      table=table)
             out.append(int(nxt[0]))
         return out

@@ -29,6 +29,26 @@ the engine/cache swap. Engines are cached per model object, and
 `warmup(model)` (wired into ServingServer.deploy) compiles the new
 version's step + observed prefill buckets BEFORE the registry pointer
 swaps — a deploy is never cold, a rollback never recompiles.
+
+Sampling rides along per request: a SamplerConfig's temperature / top-k /
+top-p / seed become batch-shaped ARRAY operands of the step wave
+(decode/sampling.py), so greedy and creative requests co-batch in one
+executable and per-request params never mint executables (GL016).
+
+Paged mode (`paged=True`, decode/paged.py): the engine's slab becomes a
+shared block pool and THIS loop thread owns the allocator — admission
+allocates each request's prompt blocks and writes its table row, a slot
+grows block-by-block as it generates, and retirement frees. The pool may
+be smaller than slots x capacity (OVERSUBSCRIPTION): admission only needs
+the prompt to fit NOW, betting most requests finish short. When the bet
+loses — a growth allocation finds the pool dry (the watermark) — the
+YOUNGEST active slot is preempted: its blocks free immediately, the
+request re-queues at the FRONT with its partial tokens, and on re-admission
+it re-prefills prompt+partial in one bucket pass whose sampling step index
+continues the seeded stream exactly (the preemption is invisible in the
+token stream). Deadline-expired and preempted slots retire through the
+same `_release_slot` path, so slot ids, pool blocks, and the active_slots
+gauge can never leak however a request leaves its slot.
 """
 from __future__ import annotations
 
@@ -42,14 +62,17 @@ from ..serving.admission import (DeadlineExceeded, RejectedError,
 from ..serving.registry import NoModelDeployed
 from ..telemetry.trace import current_span, get_tracer
 from ..util.time_source import monotonic_s
+from .paged import BlockPool, PoolExhausted, blocks_for, make_table
+from .sampling import batch_operands
 
 
 class GenerateRequest:
     __slots__ = ("prompt", "max_new_tokens", "stop_id", "future", "deadline",
                  "enqueued_at", "trace_ctx", "tokens", "slot", "version",
-                 "ttft_ms", "finish_reason")
+                 "ttft_ms", "finish_reason", "sampler", "admit_seq")
 
-    def __init__(self, prompt, max_new_tokens, stop_id=None, deadline=None):
+    def __init__(self, prompt, max_new_tokens, stop_id=None, deadline=None,
+                 sampler=None):
         self.prompt = [int(t) for t in prompt]
         self.max_new_tokens = int(max_new_tokens)
         self.stop_id = stop_id
@@ -62,6 +85,8 @@ class GenerateRequest:
         self.version = None
         self.ttft_ms = None
         self.finish_reason = None
+        self.sampler = sampler            # SamplerConfig or None (greedy)
+        self.admit_seq = None             # admission order; youngest preempts
 
     def expired(self, now=None):
         return self.deadline is not None and \
@@ -84,10 +109,18 @@ class DecodeScheduler:
     def __init__(self, registry, metrics_registry, *, slots=4, max_len=128,
                  queue_capacity=64, default_max_new_tokens=32, tracer=None,
                  compile_tracker=None, logger=None, idle_wait_s=0.2,
-                 max_engines=4):
+                 max_engines=4, paged=False, block_size=16,
+                 pool_blocks=None):
         self.registry = registry                    # ModelRegistry
         self.slots = int(slots)
         self.max_len = int(max_len)
+        self.paged = bool(paged)
+        self.block_size = int(block_size)
+        # allocatable pool size INCLUDING the scratch block; None = fully
+        # backed (slots * ceil(max_len/bs) + 1 — no oversubscription).
+        # Smaller pools oversubscribe: admission bets requests finish short
+        # and the preempt/requeue path covers the losses.
+        self.pool_blocks = None if pool_blocks is None else int(pool_blocks)
         self.queue_capacity = int(queue_capacity)
         self.default_max_new_tokens = int(default_max_new_tokens)
         self.tracer = tracer if tracer is not None else get_tracer()
@@ -110,6 +143,12 @@ class DecodeScheduler:
         self._active = {}                           # slot -> GenerateRequest
         self._free = list(range(self.slots))
         self._observed_buckets = set()
+        self._admit_seq = 0
+        # paged-mode allocator state (loop-thread-owned, rebuilt with the
+        # cache each generation)
+        self._pool = None                           # BlockPool
+        self._table = None                          # [slots, max_blocks] i32
+        self._slot_blocks = {}                      # slot -> [block ids]
 
         reg = metrics_registry
         self.m_requests = reg.counter("decode_requests_total",
@@ -123,6 +162,10 @@ class DecodeScheduler:
             "Generate requests whose deadline passed while queued (504)")
         self.m_errors = reg.counter("decode_errors_total",
                                     "Generate requests failed in the engine")
+        self.m_preempted = reg.counter(
+            "decode_preempted_total",
+            "Slots preempted (blocks reclaimed, request re-queued with its "
+            "partial tokens) when the KV block pool ran dry")
         self.m_ttft = reg.histogram(
             "decode_ttft_ms", "Time to first token (admission to first "
             "token), ms")
@@ -142,8 +185,12 @@ class DecodeScheduler:
         reg.gauge("decode_cache_mb",
                   "KV-cache bytes resident PER SHARD (MB) for the live "
                   "engine", fn=lambda: self.cache_mb())
+        reg.gauge("decode_kv_pool_utilization",
+                  "Allocated fraction of the paged KV block pool (0 when "
+                  "the slab layout serves)",
+                  fn=lambda: self.pool_utilization())
         for c in (self.m_requests, self.m_tokens, self.m_shed,
-                  self.m_expired, self.m_errors):
+                  self.m_expired, self.m_errors, self.m_preempted):
             c.inc(0)
 
     # ------------------------------------------------------------ admission
@@ -156,9 +203,10 @@ class DecodeScheduler:
         return len(self._active)
 
     def submit(self, prompt_ids, max_new_tokens=None, timeout_ms=None,
-               stop_id=None):
+               stop_id=None, sampler=None):
         """Admit one generate request; returns its Future (shed raises
-        RejectedError, an unservable request ValueError)."""
+        RejectedError, an unservable request ValueError). `sampler` is a
+        sampling.SamplerConfig (None = greedy)."""
         max_new = self.default_max_new_tokens if max_new_tokens is None \
             else int(max_new_tokens)
         prompt = list(prompt_ids)
@@ -171,10 +219,17 @@ class DecodeScheduler:
                 f"prompt ({len(prompt)}) + max_new_tokens ({max_new}) "
                 f"exceeds the cache capacity {self.max_len}; split the "
                 "request or deploy with a larger decode_max_len")
+        if self.paged and self.pool_blocks is not None and \
+                blocks_for(len(prompt) + 1, self.block_size) > \
+                self.pool_blocks - 1:
+            raise ValueError(
+                f"prompt of {len(prompt)} tokens can never fit the KV "
+                f"block pool ({self.pool_blocks - 1} allocatable blocks of "
+                f"{self.block_size} tokens)")
         deadline = None if timeout_ms is None \
             else monotonic_s() + float(timeout_ms) / 1000.0
         req = GenerateRequest(prompt, max_new, stop_id=stop_id,
-                              deadline=deadline)
+                              deadline=deadline, sampler=sampler)
         with self._work:
             if self._closed:
                 self.m_shed.add(1)
@@ -189,11 +244,12 @@ class DecodeScheduler:
         return req.future
 
     def generate(self, prompt_ids, max_new_tokens=None, timeout_ms=None,
-                 stop_id=None, wait_s=120.0):
+                 stop_id=None, wait_s=120.0, sampler=None):
         """Blocking convenience: submit + wait; a wait timeout abandons the
         request so it cannot burn a slot generating tokens nobody reads."""
         fut = self.submit(prompt_ids, max_new_tokens=max_new_tokens,
-                          timeout_ms=timeout_ms, stop_id=stop_id)
+                          timeout_ms=timeout_ms, stop_id=stop_id,
+                          sampler=sampler)
         try:
             return fut.result(timeout=wait_s)
         except FuturesTimeoutError:
@@ -259,7 +315,7 @@ class DecodeScheduler:
         """JSON block for the serving /metrics snapshot."""
         with self._lock:     # _observed_buckets is written under this lock
             buckets = sorted(self._observed_buckets)
-        return {
+        out = {
             "requests": self.m_requests.get(),
             "tokens": self.m_tokens.get(),
             "shed": self.m_shed.get(),
@@ -274,6 +330,21 @@ class DecodeScheduler:
             "prefill_buckets": buckets,
             "cache_mb": self.cache_mb(),
         }
+        if self.paged:
+            pool = self._pool
+            out["paged"] = {
+                "block_size": self.block_size,
+                "pool_blocks": pool.capacity_blocks if pool else 0,
+                "used_blocks": pool.used_blocks if pool else 0,
+                "high_water": pool.high_water if pool else 0,
+                "utilization": self.pool_utilization(),
+                "preempted": self.m_preempted.get(),
+            }
+        return out
+
+    def pool_utilization(self):
+        pool = self._pool
+        return pool.utilization() if pool is not None else 0.0
 
     def cache_mb(self):
         """PER-SHARD KV-cache megabytes of the live engine (0.0 before the
@@ -301,7 +372,9 @@ class DecodeScheduler:
                 return hit[1]
         eng = DecodeEngine(model, slots=self.slots, max_len=self.max_len,
                            compile_tracker=self.compile_tracker,
-                           registry=self.metrics_registry)
+                           registry=self.metrics_registry, paged=self.paged,
+                           block_size=self.block_size,
+                           num_blocks=self.pool_blocks)
         with self._lock:
             self._engines[key] = (model, eng)
             self._engines.move_to_end(key)
@@ -338,6 +411,9 @@ class DecodeScheduler:
             self._free.append(slot)
         self._active.clear()
         self._cache = None                  # poisoned (possibly donated away)
+        self._pool = None                   # allocator dies with its cache
+        self._table = None
+        self._slot_blocks = {}
         if self.logger is not None:
             self.logger.error("decode_wave_failed",
                               error=f"{type(exc).__name__}: {exc}")
@@ -386,33 +462,77 @@ class DecodeScheduler:
                     r.fail(e)
             self._version = entry.version
             self._cache = self._engine.init_cache()
+            self._reset_pool()
         if self._cache is None:
             self._cache = self._engine.init_cache()
+            self._reset_pool()
         while self._free:
             r = self._pop_queued()
             if r is None:
                 return
             now = monotonic_s()
             if r.expired(now):
-                self.m_expired.add(1)
-                r.fail(DeadlineExceeded(
-                    "deadline exceeded while awaiting a decode slot"))
+                # a preempted request that expires while re-queued holds
+                # real tokens: it retires like a mid-generation deadline
+                # (partial result), NOT as a 504 — same retire path either
+                # way, so the accounting cannot diverge
+                if r.tokens:
+                    self._finish(r, "deadline")
+                else:
+                    self.m_expired.add(1)
+                    r.fail(DeadlineExceeded(
+                        "deadline exceeded while awaiting a decode slot"))
                 continue
+            # ctx is the FULL generated-so-far prefix: for a fresh request
+            # just the prompt; for a preempted one prompt+partial, whose
+            # re-prefill emits the next token at the sampling step index
+            # the lost slot would have used (seeded streams are preemption-
+            # invariant)
+            ctx = r.prompt + r.tokens
+            if self.paged:
+                need = blocks_for(len(ctx), self.block_size)
+                if need > self._pool.capacity_blocks:
+                    if r.tokens:
+                        # a preempted request outgrew the whole pool: what
+                        # it generated is the answer, same as hitting the
+                        # slab capacity wall mid-flight
+                        self._finish(r, "capacity")
+                    else:
+                        self.m_errors.add(1)
+                        r.fail(ValueError(
+                            f"context of {len(ctx)} tokens can never fit "
+                            f"the KV block pool "
+                            f"({self._pool.capacity_blocks} blocks of "
+                            f"{self.block_size})"))
+                    continue
+                if need > self._pool.free_blocks:
+                    with self._lock:
+                        self._queue.appendleft(r)
+                    return          # wait for retirements to free blocks
             slot = self._free.pop()
             r.slot, r.version = slot, self._version
-            bucket = self._engine.prefill_bucket(len(r.prompt))
+            r.admit_seq = self._admit_seq
+            self._admit_seq += 1
+            if self.paged:
+                blks = self._pool.alloc(need)
+                self._slot_blocks[slot] = blks
+                self._table[slot, :] = 0
+                self._table[slot, :len(blks)] = blks
+            bucket = self._engine.prefill_bucket(len(ctx))
             with self._lock:
                 self._observed_buckets.add(bucket)
             with self.tracer.span("decode_prefill", parent=r.trace_ctx,
                                   slot=slot, bucket=bucket,
-                                  n_prompt=len(r.prompt)):
+                                  n_prompt=len(ctx)):
                 try:
                     self._cache, nid, _ = self._engine.prefill(
-                        self._cache, slot, r.prompt)
+                        self._cache, slot, ctx, sampling=r.sampler,
+                        step_index=len(r.tokens),
+                        table=self._table if self.paged else None)
                 except Exception as e:
                     self.m_errors.add(1)
                     r.fail(e)
-                    self._free.append(slot)
+                    self._release_slot(slot)
                     if self.logger is not None:
                         self.logger.error(
                             "decode_prefill_failed", slot=slot,
@@ -428,26 +548,103 @@ class DecodeScheduler:
                             f"{type(e).__name__}: {e}"))
                     else:
                         self._cache = None
+                        self._pool = None
+                        self._table = None
+                        self._slot_blocks = {}
                     return
             now = monotonic_s()
-            r.ttft_ms = (now - r.enqueued_at) * 1000.0
-            self.m_ttft.observe(r.ttft_ms,
-                                trace_id=getattr(r.trace_ctx, "trace_id",
-                                                 None))
+            if r.ttft_ms is None:       # first admission only — a re-
+                r.ttft_ms = (now - r.enqueued_at) * 1000.0   # admission is
+                self.m_ttft.observe(r.ttft_ms,       # not a second "first
+                                    trace_id=getattr(r.trace_ctx,  # token"
+                                                     "trace_id", None))
             r.tokens.append(int(nid))
             self.m_tokens.add(1)
             self._active[slot] = r
             self._maybe_retire(slot, now)
 
+    # --------------------------------------------------------- paged alloc
+    def _reset_pool(self):
+        """(Re)build the allocator beside a fresh cache — pool state and
+        cache contents live and die together (a table pointing into a
+        previous generation's pool would read garbage)."""
+        if not self.paged or self._engine is None:
+            self._pool = None
+            self._table = None
+            self._slot_blocks = {}
+            return
+        eng = self._engine
+        self._pool = BlockPool(eng.num_blocks, eng.block_size)
+        self._table = make_table(self.slots, eng.max_blocks)
+        self._slot_blocks = {}
+
+    def _grow(self, slot):
+        """Back `slot`'s next append position with a physical block,
+        preempting the YOUNGEST active slot whenever the pool is dry (the
+        oversubscription watermark). Returns False when `slot` itself was
+        the youngest and lost its own blocks."""
+        r = self._active[slot]
+        # cache holds prompt + tokens[:-1]; the step appends tokens[-1]
+        need = blocks_for(len(r.prompt) + len(r.tokens), self.block_size)
+        row = self._slot_blocks[slot]
+        while len(row) < need:
+            try:
+                blk = self._pool.alloc(1)[0]
+            except PoolExhausted:
+                victim = max(self._active,
+                             key=lambda s: self._active[s].admit_seq)
+                self._preempt(victim)
+                if victim == slot:
+                    return False
+                continue
+            row.append(blk)
+            self._table[slot, len(row) - 1] = blk
+        return True
+
+    def _preempt(self, slot):
+        """Reclaim a slot's blocks mid-flight: the request keeps its tokens
+        and re-queues at the FRONT (it was admitted before anything queued
+        behind it); re-admission re-prefills prompt+partial."""
+        r = self._active.pop(slot)
+        self._release_slot(slot)
+        self.m_preempted.add(1)
+        with self._lock:
+            self._queue.appendleft(r)
+        if self.logger is not None:
+            self.logger.info("decode_preempted", slot=slot,
+                             n_tokens=len(r.tokens),
+                             pool_free=self._pool.free_blocks)
+
+    # ------------------------------------------------------------ stepping
     def _step_wave(self):
         if not self._active:
             return
         import numpy as np
+        if self.paged:
+            # oldest-first: seniority keeps its blocks, the youngest pays
+            for slot in sorted(self._active,
+                               key=lambda s: self._active[s].admit_seq):
+                if slot in self._active:    # not preempted as a victim
+                    self._grow(slot)
+            if not self._active:
+                return
         ids = np.zeros((self.slots,), np.int32)
+        any_sampled = False
         for slot, r in self._active.items():
             ids[slot] = r.tokens[-1]
+            any_sampled = any_sampled or r.sampler is not None
+        samp = None
+        if any_sampled:
+            # per-slot sampling params + fold_in step indexes as ARRAY
+            # operands — swinging every request never recompiles (GL016)
+            samp = batch_operands(
+                self.slots,
+                {s: r.sampler for s, r in self._active.items()},
+                {s: len(r.tokens) for s, r in self._active.items()})
         t0 = monotonic_s()
-        self._cache, nxt, _ = self._engine.step(self._cache, ids)
+        self._cache, nxt, _ = self._engine.step(
+            self._cache, ids, sampling=samp,
+            table=self._table if self.paged else None)
         wall = monotonic_s() - t0
         n_active = len(self._active)
         self.m_tps.set(n_active / max(wall, 1e-9))
@@ -459,6 +656,35 @@ class DecodeScheduler:
                                trace_id=getattr(r.trace_ctx, "trace_id",
                                                 None))
             self._maybe_retire(slot, now)
+
+    # ----------------------------------------------------------- retiring
+    def _release_slot(self, slot):
+        """The ONE place a slot id (and, paged, its pool blocks + table
+        row) returns to the free state — retire, preempt, and prefill-
+        failure all route through here, so no exit path can leak a slot or
+        strand blocks. When the last active slot leaves, the free list is
+        re-sorted so future allocations pack low block ids (defrag)."""
+        self._free.append(slot)
+        if self._pool is not None:
+            blks = self._slot_blocks.pop(slot, None)
+            if blks:
+                self._pool.free(blks)
+            self._table[slot, :] = 0
+            if not self._active:
+                self._pool.defrag()
+
+    def _finish(self, r, reason):
+        r.finish_reason = reason
+        self.m_requests.add(1)
+        r.complete()
+
+    def _retire(self, slot, r, reason):
+        self._active.pop(slot, None)
+        self._release_slot(slot)
+        self._finish(r, reason)
+        if self.logger is not None:
+            self.logger.debug("generate_done", slot=slot, reason=reason,
+                              n_tokens=len(r.tokens), version=r.version)
 
     def _maybe_retire(self, slot, now):
         r = self._active.get(slot)
@@ -477,11 +703,4 @@ class DecodeScheduler:
             reason = "deadline"
         if reason is None:
             return
-        r.finish_reason = reason
-        self._active.pop(slot, None)
-        self._free.append(slot)
-        self.m_requests.add(1)
-        r.complete()
-        if self.logger is not None:
-            self.logger.debug("generate_done", slot=slot, reason=reason,
-                              n_tokens=len(r.tokens), version=r.version)
+        self._retire(slot, r, reason)

@@ -7,6 +7,7 @@ analog of the reference shipping cuDNN-specific kernels next to the generic
 path. Kernels run in interpret mode on CPU (tests) and compile via Mosaic on
 TPU.
 """
-from .flash_attention import flash_attention, flash_decode
+from .flash_attention import (flash_attention, flash_decode,
+                              flash_decode_paged)
 
-__all__ = ["flash_attention", "flash_decode"]
+__all__ = ["flash_attention", "flash_decode", "flash_decode_paged"]

@@ -645,6 +645,43 @@ def flash_decode(q, k, v, lengths, *, scale=None, use_pallas=True,
     return out[:, :1]
 
 
+def flash_decode_paged(q, k_pool, v_pool, block_table, lengths, *,
+                       scale=None, use_pallas=True, block_k=1024,
+                       interpret=None):
+    """Decode attention through a paged KV pool (decode/paged.py).
+
+    q:           [slots, 1, heads, head_dim] — current token's query;
+    k_pool/v_pool: [num_blocks, block_size, heads, head_dim] — the shared
+                 block pool (block 0 is the scratch block);
+    block_table: [slots, max_blocks] int32 — logical block j of slot s
+                 lives in pool block block_table[s, j] (0 = unallocated);
+    lengths:     [slots] int32 — valid tokens per slot.
+
+    Token t of a slot sits at (table[t // bs], t % bs), so gathering the
+    slot's table row reconstructs its contiguous cache:
+    ``pool[table]`` -> [slots, max_blocks, bs, H, D] -> reshape to
+    [slots, max_blocks*bs, H, D], then the SAME masked decode attention as
+    the slab path (`flash_decode` / `_decode_reference` — parity-tested
+    token-for-token). Unallocated entries gather scratch garbage at
+    positions >= length, which the length mask already excludes.
+
+    The gather IS the paged indirection: XLA streams each slot's blocks
+    from wherever they sit in the pool, and the bytes read per step equal
+    the slab path's (table capacity x H x D), while the bytes RESIDENT
+    shrink to blocks actually allocated — the capacity win paging buys.
+    A Mosaic-native gather-inside-the-kernel (indexing block tiles from
+    SMEM) is the rig follow-up; the fallback/masked-reference contract is
+    identical either way."""
+    S = q.shape[0]
+    N, bs, H, D = k_pool.shape
+    nb = block_table.shape[1]
+    table = jnp.asarray(block_table, jnp.int32)
+    k = jnp.take(k_pool, table, axis=0).reshape(S, nb * bs, H, D)
+    v = jnp.take(v_pool, table, axis=0).reshape(S, nb * bs, H, D)
+    return flash_decode(q, k, v, lengths, scale=scale, use_pallas=use_pallas,
+                        block_k=block_k, interpret=interpret)
+
+
 def can_flash(Tq, Tk, D, *, block_q=256, block_k=1024, interpret=None):
     """True when the Pallas kernel can run these shapes (compiled-mode tile
     alignment on TPU; any divisor in interpret mode)."""

@@ -108,17 +108,21 @@ class MultiStepTrainable:
                 "dequantize_weights() before training")
 
     def generate(self, prompt_ids, max_new_tokens=20, stop_id=None,
-                 max_len=None):
-        """Greedy KV-cache autoregressive decode (decode/engine.py): feeds
+                 max_len=None, sampler=None):
+        """KV-cache autoregressive decode (decode/engine.py): feeds
         `prompt_ids` (token ids; one-hot happens inside the compiled
         prefill), then emits up to `max_new_tokens` ids one fixed-shape
-        decode step at a time — token-for-token identical to re-running
-        `output` on the growing sequence, without the O(T²) re-forward.
-        The engine (and its compiled executables) is cached on the model;
-        pass `max_len` to size the cache (default: prompt + new tokens,
-        rounded up). Shared by MultiLayerNetwork and ComputationGraph
-        (single-input/single-output sequence graphs; anything without
-        per-token semantics raises decode.DecodeUnsupported)."""
+        decode step at a time — greedy by default, token-for-token identical
+        to re-running `output` on the growing sequence, without the O(T²)
+        re-forward. `sampler` (a decode.SamplerConfig) switches to seeded
+        temperature/top-k/top-p sampling; the params ride as array operands
+        of the SAME executable, so swinging them between calls never
+        recompiles. The engine (and its compiled executables) is cached on
+        the model; pass `max_len` to size the cache (default: prompt + new
+        tokens, rounded up). Shared by MultiLayerNetwork and
+        ComputationGraph (single-input/single-output sequence graphs;
+        anything without per-token semantics raises
+        decode.DecodeUnsupported)."""
         from ..decode.engine import DecodeEngine, bucket_for_len
         n = len(list(prompt_ids))
         need = n + int(max_new_tokens) + 1
@@ -128,7 +132,8 @@ class MultiStepTrainable:
                 else bucket_for_len(need, 1 << 30)
             eng = self._decode_engine = DecodeEngine(self, slots=1,
                                                      max_len=cap)
-        return eng.generate(prompt_ids, max_new_tokens, stop_id=stop_id)
+        return eng.generate(prompt_ids, max_new_tokens, stop_id=stop_id,
+                            sampler=sampler)
 
     def _make_multi_step(self):
         tx = self._tx

@@ -68,7 +68,9 @@ class ServingServer(BackgroundHttpServer):
                  alert_interval_s=5.0, log_sinks=None,
                  seq_len_bucketing=True, decode=False, decode_slots=4,
                  decode_max_len=128, decode_queue_capacity=64,
-                 decode_max_new_tokens=32, quant_gate=None, mesh=None):
+                 decode_max_new_tokens=32, decode_paged=False,
+                 decode_block_size=16, decode_pool_blocks=None,
+                 quant_gate=None, mesh=None):
         # scan_dir: persistent registry directory — every ModelSerializer zip
         # in it is loaded at startup and POST /deploy accepts any model name
         # from it (see ModelRegistry.scan / deploy-by-name)
@@ -168,7 +170,9 @@ class ServingServer(BackgroundHttpServer):
                 queue_capacity=decode_queue_capacity,
                 default_max_new_tokens=decode_max_new_tokens,
                 tracer=self.tracer, compile_tracker=self.compile_tracker,
-                logger=self.logger)
+                logger=self.logger, paged=decode_paged,
+                block_size=decode_block_size,
+                pool_blocks=decode_pool_blocks)
             self.health.register("decode", self.decode.probe)
 
     # ---- health probes -----------------------------------------------------
@@ -605,12 +609,15 @@ class ServingServer(BackgroundHttpServer):
 
     def _handle_generate(self, handler):
         """POST /generate {"prompt": [token ids], "max_new_tokens"?: N,
-        "timeout_ms"?: T, "stop"?: id} -> {"tokens", "n_prompt", "version",
-        "ttft_ms", "finish_reason"}. 404 when the decode plane is off,
-        429 when shed, 504 when the deadline passed before the first token,
-        503 with no model. A deadline hit MID-generation answers 200 with
-        the partial tokens and finish_reason="deadline" (the per-token
-        budget semantics)."""
+        "timeout_ms"?: T, "stop"?: id, "temperature"?: T, "top_k"?: K,
+        "top_p"?: P, "seed"?: S} -> {"tokens", "n_prompt", "version",
+        "ttft_ms", "finish_reason"}. Sampling params become array operands
+        of the shared decode step (decode/sampling.py) — any mix per
+        request, zero recompiles; omitting them decodes greedily. 404 when
+        the decode plane is off, 429 when shed, 504 when the deadline
+        passed before the first token, 503 with no model. A deadline hit
+        MID-generation answers 200 with the partial tokens and
+        finish_reason="deadline" (the per-token budget semantics)."""
         if self.decode is None:
             handler.send_json(
                 404, {"error": "decode plane disabled; start the server "
@@ -622,12 +629,19 @@ class ServingServer(BackgroundHttpServer):
             handler.send_json(400, {"error": "prompt must be a non-empty "
                                              "list of token ids"})
             return
+        from ..decode.sampling import SamplerConfig
+        try:
+            sampler = SamplerConfig.from_request(d)
+        except (TypeError, ValueError) as e:
+            handler.send_json(400, {"error": f"bad sampling params: {e}"})
+            return
         timeout_ms = d.get("timeout_ms", self.default_timeout_ms)
         with self.tracer.span("generate", n_prompt=len(prompt)) as root:
             try:
                 fut = self.decode.submit(
                     prompt, max_new_tokens=d.get("max_new_tokens"),
-                    timeout_ms=timeout_ms, stop_id=d.get("stop"))
+                    timeout_ms=timeout_ms, stop_id=d.get("stop"),
+                    sampler=sampler)
                 wait_s = 120.0 if timeout_ms is None \
                     else float(timeout_ms) / 1000.0 + 120.0
                 try:

@@ -931,6 +931,125 @@ def test_gl015_repo_dispatch_paths_are_clean():
     assert report.violations == [], [str(v) for v in report.violations]
 
 
+HOT_DECODE = "deeplearning4j_tpu/decode/engine.py"
+
+
+def test_gl016_detects_static_sampling_args():
+    """Sampling params as jit static args fire in every resolvable
+    spelling: static_argnames strings, static_argnums into a module-level
+    def / inline lambda, and the @partial(jax.jit, ...) decorator."""
+    seeded = textwrap.dedent("""\
+    import functools
+
+    import jax
+
+    def _step(params, cache, ids, top_k):
+        return ids
+
+    by_name = jax.jit(_step, static_argnames=("temperature", "bucket"))
+    by_num = jax.jit(_step, static_argnums=(3,))
+    by_lambda = jax.jit(lambda ids, seed: ids, static_argnums=(1,))
+
+    class Engine:
+        @functools.partial(jax.jit, static_argnums=(2,))
+        def step(self, ids, sampler):
+            return ids
+    """)
+    flagged = lint(seeded, rel_path=HOT_DECODE, rules=["GL016"])
+    assert [v.line for v in flagged] == [8, 9, 10, 13], flagged
+    assert all(v.rule == "GL016" for v in flagged)
+    assert "temperature" in flagged[0].message
+    assert "top_k" in flagged[1].message
+    assert "seed" in flagged[2].message
+    assert "sampler" in flagged[3].message
+
+
+def test_gl016_detects_sampling_cache_keys():
+    """A sampling VALUE flowing into a lookup key fires: bare
+    Name/Attribute keys, composite tuple keys, f-string keys, and the
+    dict .get/.setdefault/.pop key argument."""
+    seeded = textwrap.dedent("""\
+    class Engine:
+        def step(self, cfg, bucket, seed):
+            fn = self._fns[(bucket, cfg.temperature)]
+            fn = self._fns[f"step:{bucket}:{seed}"]
+            fn = self._fns[cfg.seed]
+            return self._cache.get((bucket, cfg.top_p))
+    """)
+    flagged = lint(seeded, rel_path=HOT_DECODE, rules=["GL016"])
+    assert [v.line for v in flagged] == [3, 4, 5, 6], flagged
+    assert all(v.rule == "GL016" for v in flagged)
+
+
+def test_gl016_edges():
+    # string-constant subscripts are the LEGITIMATE operand-dict /
+    # request-parsing read — the field name is fixed, values live in the
+    # array — and must stay quiet
+    parsing = textwrap.dedent("""\
+    def _handle_generate(self, d):
+        t = d["temperature"]
+        p = d.get("top_p", 1.0)
+        ops["seed"][slot] = cfg.seed
+        return t, p
+    """)
+    assert lint(parsing, rel_path="deeplearning4j_tpu/serving/server.py",
+                rules=["GL016"]) == []
+    # arithmetic index expressions are array math on a distribution, not
+    # an executable-cache key (filter_probs_np's kth-largest threshold)
+    math = textwrap.dedent("""\
+    import numpy as np
+
+    def filter_probs(p, config):
+        order = np.argsort(-p)
+        return p[order][config.top_k - 1]
+    """)
+    assert lint(math, rel_path="deeplearning4j_tpu/decode/sampling.py",
+                rules=["GL016"]) == []
+    # slicing a sampling-named ARRAY is operand math, not a key
+    operand = textwrap.dedent("""\
+    def keep_mask(probs, top_k, top_p):
+        return probs * top_p[:, None] + top_k[:, None]
+    """)
+    assert lint(operand, rel_path="deeplearning4j_tpu/decode/sampling.py",
+                rules=["GL016"]) == []
+    # shape-bucket static args are the SANCTIONED jit-cache discipline
+    shapes = textwrap.dedent("""\
+    import jax
+    fn = jax.jit(step_fn, static_argnames=("bucket", "window"))
+    """)
+    assert lint(shapes, rel_path=HOT_DECODE, rules=["GL016"]) == []
+    # whole-word matching: `reseed`/`processed` don't contain a sampling
+    # param, `seed_bucket` does
+    words = textwrap.dedent("""\
+    class E:
+        def step(self, reseed, processed, seed_bucket):
+            a = self._fns[(1, reseed)]
+            b = self._fns[(1, processed)]
+            return self._fns[(1, seed_bucket)]
+    """)
+    flagged = lint(words, rel_path=HOT_DECODE, rules=["GL016"])
+    assert [v.line for v in flagged] == [5], flagged
+    # outside serving//decode/ the rule is scoped off entirely (training
+    # code may legitimately close over a fixed seed)
+    cold = textwrap.dedent("""\
+    import jax
+    fn = jax.jit(step_fn, static_argnames=("temperature",))
+    """)
+    assert lint(cold, rules=["GL016"]) == []
+    assert lint(cold, rel_path="deeplearning4j_tpu/zoo/lm.py",
+                rules=["GL016"]) == []
+
+
+def test_gl016_repo_decode_paths_are_clean():
+    """Satellite gate: the decode + serving subsystems obey their own rule
+    — sampling params ride as array operands everywhere, zero GL016
+    findings, zero baselined remainders."""
+    report = Analyzer(rules=[get_rule("GL016")], root=str(REPO)).analyze_paths(
+        ["deeplearning4j_tpu", "tools"])
+    assert report.errors == []
+    assert report.violations == [], [str(v) for v in report.violations]
+
+
 # ---------------------------------------------------------------- baseline
 
 def test_baseline_round_trip_via_cli(tmp_path):
@@ -1062,7 +1181,7 @@ def test_cli_rule_subset_and_list_rules():
     assert [r.id for r in all_rules()] == \
         ["GL001", "GL002", "GL003", "GL004", "GL005", "GL006", "GL007",
          "GL008", "GL009", "GL010", "GL011", "GL012", "GL013", "GL014",
-         "GL015"]
+         "GL015", "GL016"]
 
 
 def test_repo_gate_is_clean_and_fast():
