@@ -226,14 +226,27 @@ def _measure_ceilings():
 
 def _step_cost(net, inputs, labels):
     """XLA's flops + bytes-accessed for the compiled ComputationGraph train
-    step (the arithmetic behind roofline_util; see PERF.md)."""
+    step (the arithmetic behind roofline_util; see PERF.md), read through the
+    SAME telemetry.cost helper the live /profile/cost plane uses, and
+    cross-checked against an ExecutableCostRegistry capture of the same
+    executable: the offline bench numbers and the live serving telemetry
+    must agree exactly (one extraction path) or the bench fails loudly."""
+    from deeplearning4j_tpu.telemetry.cost import (ExecutableCostRegistry,
+                                                   compiled_costs)
+    from deeplearning4j_tpu.telemetry.registry import MetricsRegistry
     step = net._jit_cache["std"]
     comp = step.lower(net.params, net.opt_state, net.states, net._rng,
                       inputs, labels, None, None, None).compile()
-    ca = comp.cost_analysis()
-    if isinstance(ca, list):
-        ca = ca[0]
-    return float(ca["flops"]), float(ca["bytes accessed"])
+    costs = compiled_costs(comp)
+    batch = int(inputs[0].shape[0])
+    live = ExecutableCostRegistry(MetricsRegistry()).capture_compiled(
+        "bench:train_step", comp, family="bench", samples=batch)
+    for key in ("flops", "hbm_bytes"):
+        got, want = live[key + "_per_sample"] * batch, costs[key]
+        if abs(got - want) > 0.05 * max(abs(want), 1.0):
+            raise AssertionError(
+                f"live/offline {key} disagree: {got} vs {want}")
+    return costs["flops"], costs["hbm_bytes"]
 
 
 def bench_resnet50(batch=256, image=224, steps=20, K=5,
@@ -571,10 +584,10 @@ def bench_flash_attention(B=4, H=8, T=4096, D=64, K=8):
 
             def loss(q, k, v, fn=fn):
                 return jnp.sum(fn(q, k, v, causal=True).astype(jnp.float32))
+            from deeplearning4j_tpu.telemetry.cost import compiled_costs
             comp = jax.jit(jax.grad(loss, argnums=(0, 1, 2))).lower(
                 q, k, v).compile()
-            out[name + "_temp_mb"] = \
-                comp.memory_analysis().temp_size_in_bytes / 1e6
+            out[name + "_temp_mb"] = compiled_costs(comp)["temp_bytes"] / 1e6
     out["speedup"] = out["reference_ms"] / out["flash_ms"]
     return out
 
@@ -1102,13 +1115,11 @@ def run(n_dev, batch, steps=20, zero=False, moment=None, want_bytes=False):
     if want_bytes:
         # XLA's own bytes-accessed accounting of the compiled sharded step:
         # the headline xla_step_gb delta, measured on the fixed workload
+        from deeplearning4j_tpu.telemetry.cost import compiled_costs
         comp = tr._step.lower(net.params, net.opt_state, net.states,
                               net._rng, jnp.asarray(x), jnp.asarray(y),
                               None, None, None).compile()
-        ca = comp.cost_analysis()
-        if isinstance(ca, list):
-            ca = ca[0]
-        step_bytes = float(ca["bytes accessed"])
+        step_bytes = compiled_costs(comp)["hbm_bytes"]
     for _ in range(2):
         tr.fit_batch(ds)
     t0 = time.perf_counter()
@@ -1302,14 +1313,15 @@ def main():
                 # nominal HBM rate to finish in the measured step time —
                 # there is no bandwidth headroom left. Matmul leg uses the
                 # measured (stable) MXU ceiling.
-                t_mm_ms = flops / tf_ceiling * 1e3
-                t_bw_ms = nbytes / V5E_PEAK_HBM * 1e3
-                extras["roofline_compute_ms"] = round(t_mm_ms, 1)
-                extras["roofline_hbm_ms"] = round(t_bw_ms, 1)
-                extras["roofline_binding"] = ("hbm" if t_bw_ms > t_mm_ms
-                                              else "matmul")
-                extras["roofline_util"] = round(
-                    max(t_mm_ms, t_bw_ms) / step_ms, 3)
+                from deeplearning4j_tpu.telemetry.cost import classify
+                cls = classify(flops, nbytes, tflops_ceiling=tf_ceiling,
+                               hbm_bps_ceiling=V5E_PEAK_HBM,
+                               measured_ms=step_ms)
+                extras["roofline_compute_ms"] = round(
+                    cls["roofline_compute_ms"], 1)
+                extras["roofline_hbm_ms"] = round(cls["roofline_hbm_ms"], 1)
+                extras["roofline_binding"] = cls["roofline_binding"]
+                extras["roofline_util"] = round(cls["roofline_util"], 3)
                 extras["roofline_note"] = (
                     "hbm leg vs nominal 820 GB/s; the measured elementwise "
                     "stream ceiling (hbm_gbps_ceiling) underruns conv DMA, "

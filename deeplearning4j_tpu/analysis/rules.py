@@ -1,4 +1,4 @@
-"""GL001–GL016: the rule catalog (see RULES.md for the bug-history rationale).
+"""GL001–GL017: the rule catalog (see RULES.md for the bug-history rationale).
 
 Each rule is intra-file AST analysis with light import resolution: aliases
 from ``import x as y`` / ``from m import n as y`` are resolved so
@@ -1377,3 +1377,86 @@ class SamplingRecompileKeyRule(Rule):
                 "novel value and each miss is a fresh trace+compile in "
                 "the decode hot path — key by SHAPE (bucket, window, "
                 "slot count) and pass sampling values as array operands")
+
+
+# ---------------------------------------------------------------------------
+# GL017 — untracked-jit-cache
+# ---------------------------------------------------------------------------
+
+@register
+class UntrackedJitCacheRule(Rule):
+    """jax.jit result stored into an executable cache without telemetry."""
+
+    id = "GL017"
+    name = "untracked-jit-cache"
+    rationale = (
+        "Every executable the hot modules cache (`self._jit_cache[key]`, "
+        "decode step tables, bucket dicts) is supposed to funnel through "
+        "the compile-telemetry seam — `timed_first_call` / `CompileTracker` "
+        "— which is also where the live cost plane (telemetry/cost.py) "
+        "captures XLA's flops/bytes for `/profile/cost`. A bare "
+        "`cache[key] = jax.jit(fn)` compiles and dispatches INVISIBLY: no "
+        "jit_compiles_total counter, no compile-time gauge, no cost row — "
+        "ISSUE 19's whole failure mode of 'which executable is eating the "
+        "bandwidth' with one row missing. In serving/, decode/, and nn/, "
+        "wrap the jitted callable in timed_first_call(..., label) (or route "
+        "it through CompileTracker/the cost registry) before caching it.")
+
+    #: the modules whose cached executables must show up in cost telemetry
+    HOT_PREFIXES = ("deeplearning4j_tpu/serving/",
+                    "deeplearning4j_tpu/decode/",
+                    "deeplearning4j_tpu/nn/")
+    _JIT = ("jax.jit", "jax.pjit")
+    #: wrapper callables that route the compile through the telemetry plane;
+    #: matched on the resolved qualname's last component so both
+    #: `timed_first_call(...)` and `xla.timed_first_call(...)` count
+    _TRACKED = frozenset({"timed_first_call", "capture", "capture_compiled"})
+    #: dict methods that store their second argument under a key
+    _STORES = ("setdefault",)
+
+    def check(self, ctx):
+        if not ctx.rel_path.startswith(self.HOT_PREFIXES):
+            return
+        aliases = ctx.aliases
+        for node in ctx.nodes:
+            if not (isinstance(node, ast.Call)
+                    and call_qual(node, aliases) in self._JIT):
+                continue
+            store = self._cache_store(ctx, node, aliases)
+            if store is not None:
+                yield self.violation(
+                    ctx, store,
+                    "jax.jit result stored into an executable cache without "
+                    "compile telemetry: wrap it in timed_first_call(jit_fn, "
+                    "\"<label>\") so jit_compiles_total / compile seconds / "
+                    "the /profile/cost row exist for this executable")
+
+    def _cache_store(self, ctx, jit_call, aliases):
+        """The store statement if this jit call's value lands directly in a
+        subscript assignment or dict.setdefault WITHOUT passing through a
+        tracked wrapper on the way; None otherwise (returns, local names,
+        and anything opaque stay quiet — shallow and sound-enough)."""
+        child = jit_call
+        for anc in ctx.ancestors(jit_call):
+            if isinstance(anc, ast.Call):
+                fn = anc.func
+                last = fn.attr if isinstance(fn, ast.Attribute) else (
+                    fn.id if isinstance(fn, ast.Name) else None)
+                qual = qualname(fn, aliases)
+                if qual is not None:
+                    last = qual.rsplit(".", 1)[-1]
+                if last in self._TRACKED:
+                    return None               # routed through telemetry
+                if last in self._STORES and len(anc.args) >= 2 \
+                        and child is anc.args[1]:
+                    return anc                # d.setdefault(key, jax.jit(...))
+            elif isinstance(anc, ast.Assign):
+                if child is anc.value and any(
+                        isinstance(t, ast.Subscript) for t in anc.targets):
+                    return anc                # cache[key] = jax.jit(...)
+                return None
+            elif isinstance(anc, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                  ast.Lambda, ast.Return, ast.Module)):
+                return None
+            child = anc
+        return None

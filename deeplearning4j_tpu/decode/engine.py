@@ -186,7 +186,8 @@ def build_plan(model):
 
 class DecodeEngine:
     def __init__(self, model, *, slots=4, max_len=128, compile_tracker=None,
-                 registry=None, paged=False, block_size=16, num_blocks=None):
+                 registry=None, paged=False, block_size=16, num_blocks=None,
+                 cost_registry=None):
         self.model = model
         self.slots = int(slots)
         self.capacity = int(max_len)
@@ -226,6 +227,11 @@ class DecodeEngine:
                            else self._dtype)
         self.compile_tracker = compile_tracker
         self.registry = registry            # MetricsRegistry for jit counters
+        # live cost attribution (telemetry/cost.py): each decode executable
+        # family (step / prefill:L / verify:W) is captured at first call and
+        # its wall time sampled every Nth dispatch (the sync is paid only on
+        # sampled dispatches — decode steps are otherwise async)
+        self.cost_registry = cost_registry
         # mesh-sharded decode (serving/mesh.py): a wrapped model carries the
         # serving MeshContext; the KV cache partitions its head axis over
         # the mesh model axis and the step/prefill executables pin the
@@ -606,9 +612,26 @@ class DecodeEngine:
         """Invoke a decode executable; the first call per label is the XLA
         compile and is timed into the compile accounting (CompileTracker
         phase="decode" + jit_compiles_total), same discipline as the
-        batcher's observed buckets."""
+        batcher's observed buckets. With a cost registry attached, the first
+        call also captures the executable's XLA costs (from an abstract-arg
+        snapshot taken BEFORE the donating call) and every Nth later call is
+        wall-timed into the sampled dispatch_ms histogram."""
+        cr = self.cost_registry
         if label in self._compiled:
+            if cr is not None and cr.dispatch_due(label):
+                t0 = monotonic_s()
+                out = fn(*args)
+                jax.block_until_ready(out[1])
+                cr.observe_dispatch(label, (monotonic_s() - t0) * 1000.0)
+                return out
             return fn(*args)
+        abs_args = None
+        if cr is not None:
+            try:
+                from ..telemetry.cost import abstractify
+                abs_args = abstractify(args)
+            except Exception:
+                abs_args = None
         t0 = monotonic_s()
         out = fn(*args)
         jax.block_until_ready(out[1])
@@ -617,7 +640,24 @@ class DecodeEngine:
         record_jit_compile(label, ms, registry=self.registry)
         if self.compile_tracker is not None:
             self.compile_tracker.record(ms, bucket=bucket, phase="decode")
+        if cr is not None and abs_args is not None:
+            cr.capture(label, fn, abs_args, family="decode",
+                       samples=self._cost_samples(label))
+            cr.dispatch_due(label)
+            cr.observe_dispatch(label, ms)
         return out
+
+    def _cost_samples(self, label):
+        """Tokens one execution of this executable serves — the per-token
+        normalizer for the cost table: a step advances every slot one
+        token; prefill:L ingests L tokens; verify:W scores a W-token
+        window."""
+        if label == "decode_step":
+            return self.slots
+        tail = label.rsplit(":", 1)
+        if len(tail) == 2 and tail[1].isdigit():
+            return int(tail[1])
+        return 1
 
     def prefill_bucket(self, n):
         return bucket_for_len(n, self.capacity)

@@ -110,7 +110,7 @@ class DecodeScheduler:
                  queue_capacity=64, default_max_new_tokens=32, tracer=None,
                  compile_tracker=None, logger=None, idle_wait_s=0.2,
                  max_engines=4, paged=False, block_size=16,
-                 pool_blocks=None):
+                 pool_blocks=None, cost_registry=None):
         self.registry = registry                    # ModelRegistry
         self.slots = int(slots)
         self.max_len = int(max_len)
@@ -125,6 +125,7 @@ class DecodeScheduler:
         self.default_max_new_tokens = int(default_max_new_tokens)
         self.tracer = tracer if tracer is not None else get_tracer()
         self.compile_tracker = compile_tracker
+        self.cost_registry = cost_registry
         self.logger = logger
         self.idle_wait_s = float(idle_wait_s)
         self.max_engines = int(max_engines)
@@ -374,7 +375,8 @@ class DecodeScheduler:
                            compile_tracker=self.compile_tracker,
                            registry=self.metrics_registry, paged=self.paged,
                            block_size=self.block_size,
-                           num_blocks=self.pool_blocks)
+                           num_blocks=self.pool_blocks,
+                           cost_registry=self.cost_registry)
         with self._lock:
             self._engines[key] = (model, eng)
             self._engines.move_to_end(key)

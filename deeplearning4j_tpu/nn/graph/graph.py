@@ -21,6 +21,7 @@ from ..conf.configuration import BackpropType
 from ..layers.base import create_layer
 from ..layers import feedforward, convolution, recurrent, misc, variational  # noqa: F401
 from ..multistep import MultiStepTrainable
+from ...telemetry.xla import timed_first_call
 from ..updaters import apply_gradient_normalization
 from ...optimize.listeners import resolve_listeners
 
@@ -325,9 +326,13 @@ class ComputationGraph(MultiStepTrainable):
 
     def _get_train_step(self, key="std"):
         """One cached jitted step per mode; jit itself retraces per input
-        structure (mask presence etc.), so no structure-derived keys needed."""
+        structure (mask presence etc.), so no structure-derived keys needed.
+        timed_first_call routes the compile through the jit accounting and
+        the cost registry (telemetry/cost.py) like the MLN train steps."""
         if key not in self._jit_cache:
-            self._jit_cache[key] = self._make_train_step(tbptt=(key == "tbptt"))
+            self._jit_cache[key] = timed_first_call(
+                self._make_train_step(tbptt=(key == "tbptt")),
+                f"graph_train_step:{key}")
         return self._jit_cache[key]
 
     def fit(self, data, labels=None, epochs=1, steps_per_execution=1,
@@ -518,7 +523,9 @@ class ComputationGraph(MultiStepTrainable):
                 acts, _, _, _ = self._forward(params, states, xs, train=False,
                                               rng=None, masks=masks)
                 return [acts[o].astype(self._dtype) for o in self.conf.network_outputs]
-            self._jit_cache[key] = jax.jit(fwd)
+            self._jit_cache[key] = timed_first_call(
+                jax.jit(fwd),
+                f"graph_output:inputs={len(inputs)},mask={masked}")
         outs = self._jit_cache[key](
             self.params, self.states, inputs,
             None if mask is None else jnp.asarray(mask, self._dtype))

@@ -191,7 +191,9 @@ class MultiStepTrainable:
         mode, stacked, K = prepared
         if mode == "std":
             if "multi" not in self._jit_cache:
-                self._jit_cache["multi"] = self._make_multi_step()
+                from ..telemetry.xla import timed_first_call
+                self._jit_cache["multi"] = timed_first_call(
+                    self._make_multi_step(), "multi_step:std")
             (self.params, self.opt_state, self.states, self._rng,
              scores) = self._jit_cache["multi"](
                 self.params, self.opt_state, self.states, self._rng, stacked)

@@ -37,7 +37,8 @@ def bucket_for(rows):
 
 class DynamicBatcher:
     def __init__(self, registry, queue, metrics, max_batch_size=32,
-                 max_latency_ms=5.0, tracer=None, compile_tracker=None):
+                 max_latency_ms=5.0, tracer=None, compile_tracker=None,
+                 cost_registry=None):
         self.registry = registry
         self.queue = queue
         self.metrics = metrics
@@ -52,6 +53,10 @@ class DynamicBatcher:
         # dispatch of an unobserved (signature, bucket) IS the compile
         self.tracer = tracer if tracer is not None else get_tracer()
         self.compile_tracker = compile_tracker
+        # live cost attribution (telemetry/cost.py): first dispatch of a
+        # bucket captures the executable's XLA costs; every dispatch feeds
+        # the sampled dispatch_ms histogram
+        self.cost_registry = cost_registry
 
     # ---- lifecycle --------------------------------------------------------
     def start(self):
@@ -229,6 +234,11 @@ class DynamicBatcher:
             # attributed as the compile cost (the Julia-TPU paper's proxy)
             self.compile_tracker.record(dispatch_ms, bucket=bucket,
                                         phase="serve")
+        if self.cost_registry is not None:
+            label = self._cost_label(bucket, mask, x)
+            if first_dispatch:
+                self._capture_cost(model, x, mask, bucket, version, label)
+            self.cost_registry.record_dispatch(label, dispatch_ms)
         self.registry.count_served(version, rows)
         self.metrics.record_batch(
             bucket, sum(1 for r in batch if r.count_as_request), rows)
@@ -272,6 +282,59 @@ class DynamicBatcher:
             self._mask_ok.pop(next(iter(self._mask_ok)))
         return ok
 
+    # ---- cost attribution (telemetry/cost.py) ------------------------------
+    @staticmethod
+    def _cost_label(bucket, mask, x):
+        """Stable per-executable label (no version: a hot-swap re-captures
+        the SAME series, which is what makes deploy byte deltas visible)."""
+        if mask is not None:
+            return f"serve:b{bucket}xL{x.shape[1]}"
+        return f"serve:b{bucket}"
+
+    def _capture_cost(self, model, x, mask, bucket, version, label):
+        """Attribute this bucket's executable: re-lower the model's jitted
+        output from abstract shapes (dispatch cache untouched — the
+        zero-recompile invariant holds) and record flops/bytes per padded
+        sample. Duck-typed against both nn network `_jit_cache` layouts; a
+        model without one (exotic stand-in) is simply not attributed."""
+        try:
+            import jax
+            from ..telemetry.cost import abstractify
+            cache = getattr(model, "_jit_cache", None)
+            if cache is None:
+                return
+            rows = int(x.shape[0])
+            ctx = getattr(model, "mesh_context", None)
+            if ctx is not None:
+                # MeshDispatcher pads rows to a data-axis multiple before
+                # the inner executable sees them — lower the shape that
+                # actually compiled, not one XLA never ran
+                rows += (-rows) % ctx.data_size
+            xa = jax.ShapeDtypeStruct(
+                (rows,) + tuple(x.shape[1:]),
+                jax.dtypes.canonicalize_dtype(x.dtype))
+            ma = None
+            if mask is not None:
+                mdt = getattr(model, "_dtype", None)
+                ma = jax.ShapeDtypeStruct(
+                    (rows,) + tuple(mask.shape[1:]),
+                    jax.dtypes.canonicalize_dtype(
+                        mdt if mdt is not None else mask.dtype))
+            pa = abstractify(model.params)
+            st = abstractify(model.states)
+            masked = mask is not None
+            fn = cache.get(("output", False, masked))     # MultiLayerNetwork
+            args = (pa, st, xa, ma)
+            if fn is None:
+                fn = cache.get(("output", 1, masked))     # ComputationGraph
+                args = (pa, st, [xa], ma)
+            if fn is None:
+                return
+            self.cost_registry.capture(label, fn, args, family="serve",
+                                       samples=bucket, version=version)
+        except Exception:
+            pass    # attribution is observability, never a dispatch failure
+
     def reset_observed(self):
         """Forget recorded (signature, bucket) pairs — used when the serving
         model's input contract changes and the old shapes no longer apply."""
@@ -279,13 +342,16 @@ class DynamicBatcher:
             self.observed.clear()
 
     # ---- warm-up (used by registry deploy/rollback) ------------------------
-    def warmup(self, model):
+    def warmup(self, model, version=None):
         """Compile `model`'s executables for every (signature, bucket) this
         batcher has dispatched, so a hot-swapped version is never cold —
         seq batches replay their (batch bucket, length bucket) pair WITH a
         mask, the executable dispatch really uses. Warm-up compiles are real
         XLA compiles and are accounted as such (labeled phase="warmup"),
-        keeping deploy cost visible."""
+        keeping deploy cost visible. Each warmed bucket is also re-captured
+        in the cost registry under `version`, which is what arms the
+        deploy-time bytes-regression gauge (a quantized->f32 fallback shows
+        up HERE, before traffic does)."""
         with self._obs_lock:
             observed = sorted(self.observed,
                               key=lambda sb: (str(sb[0]), sb[1]))
@@ -298,6 +364,7 @@ class DynamicBatcher:
             else:
                 (shape, dtype), bucket = key
                 zeros = np.zeros((bucket,) + tuple(shape), dtype=dtype)
+                mask = None
                 call = lambda: np.asarray(model.output(zeros))
             with self.tracer.span("warmup_compile", bucket=bucket):
                 t0 = monotonic_s()
@@ -306,3 +373,6 @@ class DynamicBatcher:
                     self.compile_tracker.record(
                         (monotonic_s() - t0) * 1000.0, bucket=bucket,
                         phase="warmup")
+            if self.cost_registry is not None:
+                self._capture_cost(model, zeros, mask, bucket, version,
+                                   self._cost_label(bucket, mask, zeros))

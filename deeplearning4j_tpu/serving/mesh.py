@@ -47,6 +47,7 @@ from ..parallel.sharding import (DATA_AXIS, MODEL_AXIS, ShardingRules,
                                  even_sharding, make_mesh,
                                  match_partition_rules, spec_shards)
 from ..telemetry.trace import get_tracer
+from ..util.time_source import monotonic_s
 
 
 class MeshServingConfig:
@@ -119,6 +120,10 @@ class MeshContext:
                               devices=devices[:int(n_data) * n_model])
         self.rules = self.config.resolve_rules()
         self.tracer = tracer if tracer is not None else get_tracer()
+        # live cost attribution (telemetry/cost.py): the owning server
+        # attaches its ExecutableCostRegistry here so mesh-routed dispatch
+        # wall time lands in the sampled dispatch_ms histogram
+        self.cost_registry = None
         self.dispatches = 0                  # mesh-routed batch dispatches
         self._batch_shardings = {}           # ndim -> NamedSharding
         self._lock = threading.Lock()
@@ -282,6 +287,9 @@ class MeshDispatcher:
                 mask = np.concatenate(
                     [mask, np.zeros((pad,) + mask.shape[1:], mask.dtype)],
                     axis=0)
+        cr = ctx.cost_registry
+        sampled = cr is not None and cr.dispatch_due("mesh_dispatch")
+        t0 = monotonic_s() if sampled else 0.0
         # per-axis dispatch span: the chips answering this wave, by axis
         with ctx.tracer.span("mesh_dispatch", chips=ctx.chips,
                              axis_data=ctx.data_size,
@@ -297,6 +305,9 @@ class MeshDispatcher:
             with ctx.run_lock:
                 out = self.mesh_inner.output(xb, **kw)
                 jax.block_until_ready(out)
+        if sampled:
+            cr.observe_dispatch("mesh_dispatch",
+                                (monotonic_s() - t0) * 1000.0)
         ctx.dispatches += 1
         if pad:
             if isinstance(out, (list, tuple)):

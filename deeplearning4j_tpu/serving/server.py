@@ -47,6 +47,8 @@ from .metrics import ServingMetrics
 from .registry import ModelRegistry, NoModelDeployed
 from ..telemetry.alerts import (AlertEngine, RouterAlertSink,
                                 WebhookAlertSink, default_serving_rules)
+from ..telemetry.cost import (ExecutableCostRegistry, capture_trace,
+                              install_donation_watch)
 from ..telemetry.health import HealthMonitor
 from ..telemetry.logging import StructuredLogger
 from ..telemetry.prometheus import CONTENT_TYPE as PROMETHEUS_CONTENT_TYPE
@@ -98,6 +100,12 @@ class ServingServer(BackgroundHttpServer):
         # XLA compile accounting + device-memory gauges in the same registry
         # the /metrics exposition renders
         self.compile_tracker = CompileTracker(self.metrics.registry)
+        # live cost attribution (telemetry/cost.py): per-executable XLA
+        # flops/bytes captured at every compile seam, sampled dispatch_ms,
+        # the /profile/cost table, and the deploy bytes-regression gauge
+        self.cost = ExecutableCostRegistry(self.metrics.registry)
+        if self.mesh is not None:
+            self.mesh.cost_registry = self.cost
         register_device_memory_gauges(self.metrics.registry)
         self.metrics.registry.gauge(
             "queue_depth", "Requests admitted and not yet dispatched",
@@ -108,7 +116,8 @@ class ServingServer(BackgroundHttpServer):
                                       max_batch_size=max_batch_size,
                                       max_latency_ms=max_latency_ms,
                                       tracer=self.tracer,
-                                      compile_tracker=self.compile_tracker)
+                                      compile_tracker=self.compile_tracker,
+                                      cost_registry=self.cost)
         self.default_timeout_ms = default_timeout_ms
         # accuracy-parity thresholds for quantize="int8" deploys (None ->
         # nn.quant.QuantGate defaults)
@@ -128,6 +137,10 @@ class ServingServer(BackgroundHttpServer):
         # instrument-level problems (raising gauge callbacks) log HERE, so
         # they show on this server's /logs, not a process-global buffer
         self.metrics.registry.logger = self.logger
+        # XLA donation failures become donation_warnings_total{site} + a
+        # trace-correlated log record instead of unscraped stderr
+        self._donation_unwatch = install_donation_watch(self.metrics.registry,
+                                                        self.logger)
         self.health = HealthMonitor(logger=self.logger)
         self.health.register("admission", self._probe_admission)
         self.health.register("batcher", self._probe_batcher)
@@ -172,7 +185,8 @@ class ServingServer(BackgroundHttpServer):
                 tracer=self.tracer, compile_tracker=self.compile_tracker,
                 logger=self.logger, paged=decode_paged,
                 block_size=decode_block_size,
-                pool_blocks=decode_pool_blocks)
+                pool_blocks=decode_pool_blocks,
+                cost_registry=self.cost)
             self.health.register("decode", self.decode.probe)
 
     # ---- health probes -----------------------------------------------------
@@ -403,11 +417,25 @@ class ServingServer(BackgroundHttpServer):
                 "carries no input shape to synthesize them from")
         return x
 
+    def _version_of(self, model):
+        """Registry version owning `model` (identity match — the registry
+        hands warmup the exact adapted model object), or None for a model
+        outside the registry."""
+        for info in self.registry.versions():
+            try:
+                if self.registry.get(info["version"]).model is model:
+                    return info["version"]
+            except KeyError:
+                pass
+        return None
+
     def _warmup(self, model):
         """Deploy-time warm-up: batcher buckets AND (when the decode plane
         is on and the model streams) the decode executables, so neither
-        /predict nor /generate ever hits a cold hot-swapped version."""
-        self.batcher.warmup(model)
+        /predict nor /generate ever hits a cold hot-swapped version. The
+        warmed buckets re-capture their costs under the incoming version —
+        the deploy-time bytes-regression check happens HERE."""
+        self.batcher.warmup(model, version=self._version_of(model))
         if self.decode is not None:
             from ..decode.engine import DecodeUnsupported
             try:
@@ -435,7 +463,8 @@ class ServingServer(BackgroundHttpServer):
                 max_batch_size=self.batcher.max_batch_size,
                 max_latency_ms=self.batcher.max_latency_ms,
                 tracer=self.tracer,
-                compile_tracker=self.compile_tracker)
+                compile_tracker=self.compile_tracker,
+                cost_registry=self.cost)
             self.batcher.observed = observed
             self._final_flush_done = False
         self.batcher.start()
@@ -494,6 +523,24 @@ class ServingServer(BackgroundHttpServer):
                         self.send_json(200, server._metrics_snapshot())
                 elif u.path == "/trace":
                     self.send_json(200, server.tracer.to_chrome_trace())
+                elif u.path == "/profile/cost":
+                    self.send_json(200, server.cost.to_dict(
+                        sort=query.get("sort", "hbm_bytes_per_sample"),
+                        family=query.get("family")), default=str)
+                elif u.path == "/profile/trace":
+                    # bounded on-demand capture: ?steps=N spans (hard
+                    # iteration cap inside capture_trace — always stops,
+                    # never a leaked jax.profiler session)
+                    try:
+                        steps = int(query.get("steps", ""))
+                        timeout_s = min(float(query.get("timeout_s", 2.0)),
+                                        10.0)
+                        payload = capture_trace(steps, tracer=server.tracer,
+                                                timeout_s=timeout_s)
+                    except (TypeError, ValueError) as e:
+                        self.send_json(400, {"error": f"bad query: {e}"})
+                        return
+                    self.send_json(200, payload)
                 else:
                     self.send_json(404, {"error": "not found"})
 
@@ -533,6 +580,7 @@ class ServingServer(BackgroundHttpServer):
     def stop(self, drain=True, timeout=30.0):
         """Graceful drain: stop admitting (new requests shed with 429),
         serve everything already queued, then stop the HTTP server."""
+        self._donation_unwatch()    # idempotent: removes THIS subscriber
         self.alerts.stop()
         if self.decode is not None:
             self.decode.stop(drain=drain, timeout=timeout)

@@ -61,6 +61,9 @@ working = consumer wait ~0).
 from .alerts import (AlertEngine, AlertRule, LogAlertSink, RouterAlertSink,
                      WebhookAlertSink, default_serving_rules,
                      default_training_rules)
+from .cost import (ExecutableCostRegistry, abstractify, capture_trace,
+                   classify, compiled_costs, get_cost_registry,
+                   install_donation_watch, set_cost_registry)
 from .fleet import FleetCollector, FleetServer
 from .health import (DEGRADED, HEALTHY, UNHEALTHY, HealthMonitor,
                      get_monitor, set_monitor)
@@ -96,4 +99,7 @@ __all__ = ["AlertEngine", "AlertRule", "LogAlertSink", "RouterAlertSink",
            "NOOP_SPAN", "Span", "Tracer", "current_span", "enable_tracing",
            "get_tracer", "new_span_id", "new_trace_id", "set_tracer",
            "CompileTracker", "record_jit_compile",
-           "register_device_memory_gauges", "timed_first_call"]
+           "register_device_memory_gauges", "timed_first_call",
+           "ExecutableCostRegistry", "abstractify", "capture_trace",
+           "classify", "compiled_costs", "get_cost_registry",
+           "install_donation_watch", "set_cost_registry"]

@@ -430,9 +430,12 @@ class RouterAlertSink:
 
 def default_serving_rules(max_p99_ms=1000.0, error_ratio=0.05,
                           shed_ratio=0.10, window_s=60.0,
-                          for_duration_s=15.0):
+                          for_duration_s=15.0, bytes_ratio=1.2):
     """The SLO set a ServingServer watches out of the box: dispatch error
-    ratio, p99 latency, and true shed ratio (shed/(requests+shed))."""
+    ratio, p99 latency, true shed ratio (shed/(requests+shed)), and the
+    deploy-time bytes regression (a hot-swap that inflates an executable
+    family's hbm_bytes_per_sample >20% vs the previous version — the alarm
+    a quantized->f32 fallback trips; see telemetry/cost.py)."""
     return [
         AlertRule("serving_error_ratio", "ratio",
                   numerator="errors_total", denominator="requests_total",
@@ -450,6 +453,13 @@ def default_serving_rules(max_p99_ms=1000.0, error_ratio=0.05,
                   threshold=shed_ratio, window_s=window_s,
                   for_duration_s=for_duration_s, severity="warning",
                   description="admission load-shedding (429) fraction"),
+        AlertRule("deploy_bytes_regression", "threshold",
+                  metric="deploy_hbm_bytes_per_sample_ratio",
+                  threshold=bytes_ratio, op=">", for_duration_s=0.0,
+                  severity="page",
+                  description="a deploy/hot-swap raised an executable "
+                              "family's HBM bytes per sample vs the "
+                              "previous version (quantization fallback?)"),
     ]
 
 

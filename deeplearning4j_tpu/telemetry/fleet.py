@@ -19,6 +19,8 @@ aggregates:
 - `trace()`    — merged Chrome trace: each host's spans in a distinct `pid`
   lane with a `process_name` metadata record, so ui.perfetto.dev shows the
   fleet timeline host-by-host.
+- `profile()`  — merged per-executable cost table (`/profile/cost` rows)
+  with an `instance` field, fleet-sorted by HBM bytes per sample.
 
 Polling is interval-gated through util.time_source (`maybe_poll`), so a
 ManualClock drives staleness in tests with zero sleeps; `FleetServer`
@@ -127,6 +129,7 @@ class FleetCollector:
                  ("healthz", "/healthz"),
                  ("alerts", "/alerts"),
                  ("trace", "/trace"),
+                 ("profile", "/profile/cost"),
                  ("prometheus", "/metrics?format=prometheus"))
 
     def __init__(self, peers, names=None, interval_s=10.0, timeout_s=2.0):
@@ -347,6 +350,28 @@ class FleetCollector:
         return {"time": now_s(), "firing": firing, "instances": instances,
                 "rules": rows}
 
+    def profile(self):
+        """Merged per-executable cost table: every up peer's /profile/cost
+        rows with an `instance` field, fleet-sorted by hbm_bytes_per_sample
+        (the roofline-dominant axis on v5e) so the most bandwidth-hungry
+        executable anywhere in the fleet tops the table; per-instance
+        sections keep each peer's own ceilings and full table."""
+        rows, instances = [], {}
+        for name, st in self._snapshot().items():
+            body = st.get("profile")
+            if st["status"] != "up" or not isinstance(body, dict):
+                instances[name] = {"error": (st.get("errors") or {})
+                                   .get("profile") or st["error"]
+                                   or "no profile data"}
+                continue
+            instances[name] = body
+            for row in body.get("executables", []):
+                if isinstance(row, dict):
+                    rows.append({**row, "instance": name})
+        rows.sort(key=lambda r: -float(r.get("hbm_bytes_per_sample") or 0.0))
+        return {"time": now_s(), "instances": instances,
+                "executables": rows}
+
     def trace(self):
         """Merged Chrome trace: peer i's events move to pid lane i with a
         process_name metadata record, so one ui.perfetto.dev load shows the
@@ -384,6 +409,7 @@ class FleetServer(BackgroundHttpServer):
                            peer itself reports unhealthy
       GET /fleet/alerts    merged alert states, firing first
       GET /fleet/trace     merged Chrome trace, one pid lane per host
+      GET /fleet/profile   merged per-executable cost table, instance-tagged
       GET /fleet/peers     raw collector status per peer
 
     Every GET first calls `maybe_poll()` — the interval gate means a
@@ -425,6 +451,9 @@ class FleetServer(BackgroundHttpServer):
                         send_json(self, 200, collector.alerts(), default=str)
                     elif u.path == "/fleet/trace":
                         send_json(self, 200, collector.trace(), default=str)
+                    elif u.path == "/fleet/profile":
+                        send_json(self, 200, collector.profile(),
+                                  default=str)
                     elif u.path == "/fleet/peers":
                         send_json(self, 200, {
                             "peers": {name: {"url": st["url"],

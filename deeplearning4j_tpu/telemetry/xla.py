@@ -87,10 +87,23 @@ class _TimedFirstCall:
     def __call__(self, *args, **kwargs):
         if self._first:
             self._first = False
+            # Abstract-arg snapshot BEFORE the call: donated buffers are
+            # invalidated by it, and cost capture re-lowers from shapes only.
+            from .cost import abstractify, get_cost_registry
+            cost = get_cost_registry()
+            if cost is not None:
+                try:
+                    abs_args = abstractify(args)
+                    abs_kwargs = abstractify(kwargs)
+                except Exception:
+                    cost = None
             t0 = monotonic_s()
             out = self.__wrapped__(*args, **kwargs)
             record_jit_compile(self._label, (monotonic_s() - t0) * 1000.0,
                                registry=self._registry)
+            if cost is not None:
+                cost.capture(self._label, self.__wrapped__,
+                             abs_args, abs_kwargs)
             return out
         return self.__wrapped__(*args, **kwargs)
 

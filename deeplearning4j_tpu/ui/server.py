@@ -272,6 +272,47 @@ class MetricsModule(UIModule):
                 _dumps(self.registry.snapshot()))
 
 
+class ProfileModule(UIModule):
+    """`GET /profile/cost` (the sortable per-executable FLOPs/bytes/roofline
+    table) and `GET /profile/trace?steps=N` (bounded on-demand span capture)
+    for the training/UI process — the training-side mirror of the
+    ServingServer's /profile plane. The cost registry resolves at request
+    time, so a trainer that calls telemetry.set_cost_registry() after the
+    UI started is picked up; with none installed the table is empty, never
+    an error."""
+
+    def __init__(self, cost=None, tracer=None):
+        self.cost = cost
+        self.tracer = tracer            # None -> the process-default tracer
+
+    def routes(self):
+        return {("GET", "/profile/cost"): self._cost,
+                ("GET", "/profile/trace"): self._trace}
+
+    def _cost(self, query, body):
+        from ..telemetry.cost import get_cost_registry
+        from ..util.http import dumps_safe
+        cr = self.cost if self.cost is not None else get_cost_registry()
+        payload = {"ceilings": None, "executables": []} if cr is None \
+            else cr.to_dict(sort=query.get("sort", "hbm_bytes_per_sample"),
+                            family=query.get("family"))
+        return (200, "application/json",
+                dumps_safe(payload, default=str).encode())
+
+    def _trace(self, query, body):
+        from ..telemetry.cost import capture_trace
+        from ..util.http import dumps_safe
+        try:
+            steps = int(query.get("steps", ""))
+            timeout_s = min(float(query.get("timeout_s", 2.0)), 10.0)
+            payload = capture_trace(steps, tracer=self.tracer,
+                                    timeout_s=timeout_s)
+        except (TypeError, ValueError) as e:
+            return (400, "application/json",
+                    dumps_safe({"error": f"bad query: {e}"}).encode())
+        return 200, "application/json", dumps_safe(payload).encode()
+
+
 class HealthModule(UIModule):
     """Deep `GET /healthz` for the training/UI process: aggregates the
     HealthMonitor's component probes (ETL pipelines, the trainer via
@@ -372,13 +413,14 @@ class UIServer(BackgroundHttpServer):
     _instance = None
 
     def __init__(self, port=9000, modules=None, registry=None, health=None,
-                 alerts=None, logger=None):
+                 alerts=None, logger=None, cost=None):
         super().__init__(host="127.0.0.1", port=port)
         self.storage = None
         self.modules = modules or [DefaultModule(), TrainModule(),
                                    HistogramModule(), FlowModule(),
                                    ConvolutionalModule(), TsneModule(),
                                    MetricsModule(registry),
+                                   ProfileModule(cost),
                                    HealthModule(health),
                                    AlertsModule(alerts),
                                    LogsModule(logger),

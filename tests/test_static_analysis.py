@@ -1050,6 +1050,83 @@ def test_gl016_repo_decode_paths_are_clean():
     assert report.violations == [], [str(v) for v in report.violations]
 
 
+HOT_NN = "deeplearning4j_tpu/nn/graph/graph.py"
+
+
+def test_gl017_detects_bare_jit_cache_store():
+    """A jax.jit result stored straight into a cache subscript or via
+    dict.setdefault fires in the serving/decode/nn hot modules."""
+    seeded = textwrap.dedent("""\
+    import jax
+
+    class Net:
+        def _get_step(self, key, fn):
+            self._jit_cache[key] = jax.jit(fn, donate_argnums=(0, 1))
+            return self._jit_cache[key]
+
+        def _get_fwd(self, key, fn):
+            return self._fns.setdefault(key, jax.jit(fn))
+    """)
+    for rel in (HOT_NN, HOT_SERVING, HOT_DECODE):
+        flagged = lint(seeded, rel_path=rel, rules=["GL017"])
+        assert [v.line for v in flagged] == [5, 9], (rel, flagged)
+        assert all(v.rule == "GL017" for v in flagged)
+
+
+def test_gl017_edges():
+    # the telemetry-routed store (the repo idiom) is quiet
+    tracked = textwrap.dedent("""\
+    import jax
+    from ..telemetry.xla import timed_first_call
+
+    class Net:
+        def _get_step(self, key, fn):
+            self._jit_cache[key] = timed_first_call(
+                jax.jit(fn, donate_argnums=(0, 1)), f"train_step:{key}")
+            return self._jit_cache[key]
+    """)
+    assert lint(tracked, rel_path=HOT_NN, rules=["GL017"]) == []
+    # returning a fresh jit (factory methods) and binding a local name are
+    # NOT cache stores — shallow-and-sound, the rule stays quiet
+    quiet = textwrap.dedent("""\
+    import jax
+
+    class Net:
+        def _make_step(self, fn):
+            return jax.jit(fn, donate_argnums=(2,))
+
+        def _once(self, fn):
+            pstep = jax.jit(fn)
+            return pstep(1.0)
+    """)
+    assert lint(quiet, rel_path=HOT_DECODE, rules=["GL017"]) == []
+    # outside the hot prefixes the rule is scoped off entirely
+    seeded = textwrap.dedent("""\
+    import jax
+
+    def cache_it(cache, key, fn):
+        cache[key] = jax.jit(fn)
+    """)
+    assert lint(seeded, rules=["GL017"]) == []
+    assert lint(seeded, rel_path="deeplearning4j_tpu/etl/prefetch.py",
+                rules=["GL017"]) == []
+    # an inline suppression with a rationale still silences it in-scope
+    marked = seeded.replace("cache[key] = jax.jit(fn)",
+                            "cache[key] = jax.jit(fn)  "
+                            "# graftlint: disable=GL017 <deliberate>")
+    assert lint(marked, rel_path=HOT_SERVING, rules=["GL017"]) == []
+
+
+def test_gl017_repo_jit_caches_are_tracked():
+    """Satellite gate: every executable cache in serving/, decode/, and nn/
+    funnels through the compile-telemetry seam — zero GL017 findings, zero
+    baselined remainders."""
+    report = Analyzer(rules=[get_rule("GL017")], root=str(REPO)).analyze_paths(
+        ["deeplearning4j_tpu", "tools"])
+    assert report.errors == []
+    assert report.violations == [], [str(v) for v in report.violations]
+
+
 # ---------------------------------------------------------------- baseline
 
 def test_baseline_round_trip_via_cli(tmp_path):
@@ -1181,7 +1258,7 @@ def test_cli_rule_subset_and_list_rules():
     assert [r.id for r in all_rules()] == \
         ["GL001", "GL002", "GL003", "GL004", "GL005", "GL006", "GL007",
          "GL008", "GL009", "GL010", "GL011", "GL012", "GL013", "GL014",
-         "GL015", "GL016"]
+         "GL015", "GL016", "GL017"]
 
 
 def test_repo_gate_is_clean_and_fast():
