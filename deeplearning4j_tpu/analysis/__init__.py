@@ -9,11 +9,14 @@ lint-as-a-test-gate discipline JAX itself and large TF codebases use for
 trace/host-sync hazards. This package is that checker.
 
 Pieces:
-  core.py      Rule SPI, registry, suppression comments, Analyzer
-  rules.py     GL001–GL006 (see RULES.md for the catalog + rationale)
-  baseline.py  committed-baseline support (pre-existing violations don't
-               block; NEW ones fail)
-  cli.py       `python -m deeplearning4j_tpu.analysis` / tools/lint.py
+  core.py         Rule SPI, registry, suppression comments, Analyzer (with
+                  the begin_program hook for whole-program rules)
+  rules.py        per-file rules (see RULES.md for the catalog + rationale)
+  concurrency.py  whole-program lockset inference + lock-order graph:
+                  GL003 (annotation channel), GL018–GL020
+  baseline.py     committed-baseline support (pre-existing violations don't
+                  block; NEW ones fail)
+  cli.py          `python -m deeplearning4j_tpu.analysis` / tools/lint.py
 
 Run:   python tools/lint.py [paths...] [--format=json|text]
 Gate:  tests/test_static_analysis.py runs the whole pass in tier-1.
@@ -22,6 +25,7 @@ from .baseline import Baseline
 from .core import Analyzer, FileContext, Report, Rule, Violation, all_rules, \
     get_rule, register
 from . import rules  # noqa: F401  (import for the registration side effect)
+from . import concurrency  # noqa: F401  (GL003/GL018–GL020 registration)
 
 __all__ = [
     "Analyzer", "Baseline", "FileContext", "Report", "Rule", "Violation",

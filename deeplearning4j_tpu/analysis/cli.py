@@ -12,6 +12,8 @@ Options:
     --baseline PATH      baseline file (default tools/lint_baseline.json)
     --baseline-update    rewrite the baseline from current findings (keeps
                          notes on still-matching entries) and exit 0
+    --baseline-prune     delete only the STALE entries (fixed code the
+                         findings no longer match); never adds entries
     --no-baseline        ignore the baseline: report every violation as new
     --rules GL001,GL002  run a subset of rules
     --list-rules         print the rule catalog and exit
@@ -57,6 +59,10 @@ def build_parser():
                         f"(default: {DEFAULT_BASELINE} under --root)")
     p.add_argument("--baseline-update", action="store_true",
                    help="rewrite the baseline from current findings")
+    p.add_argument("--baseline-prune", action="store_true",
+                   help="delete baseline entries no current finding matches "
+                        "(scoped to the analyzed files and active rules); "
+                        "unlike --baseline-update this never ADDS entries")
     p.add_argument("--no-baseline", action="store_true",
                    help="ignore the baseline entirely")
     p.add_argument("--rules", default=None,
@@ -96,6 +102,38 @@ def main(argv=None) -> int:
     baseline_path = (os.path.join(root, DEFAULT_BASELINE)
                      if args.baseline is None
                      else os.path.abspath(args.baseline))
+
+    if args.baseline_update and args.baseline_prune:
+        print("graftlint: --baseline-update already drops stale entries; "
+              "pass one or the other, not both")
+        return 2
+
+    if args.baseline_prune:
+        if report.errors:
+            # refuse: an unparseable file yields zero findings, so every one
+            # of its entries would look stale and be wrongly deleted
+            for err in report.errors:
+                print(f"PARSE ERROR: {err}")
+            print("graftlint: baseline NOT pruned (fix the errors first)")
+            return 1
+        previous = Baseline.load(baseline_path)
+        # prune is scoped exactly like --baseline-update: an entry is a
+        # candidate only if this run actually re-checked it (its file was
+        # analyzed AND its rule was active); everything else is untouchable
+        analyzed = set(report.rel_files)
+        active = {r.id for r in analyzer.rules}
+        in_scope = [e for e in previous.entries
+                    if e["path"] in analyzed and e["rule"] in active]
+        stale = Baseline(in_scope).stale_entries(report.violations)
+        stale_ids = {id(e) for e in stale}      # identity, not equality:
+        kept = [e for e in previous.entries     # duplicate (rule,path,code)
+                if id(e) not in stale_ids]      # entries prune one-for-one
+        Baseline(kept).save(baseline_path)
+        print(f"graftlint: baseline pruned: {len(stale)} stale "
+              f"entr{'y' if len(stale) == 1 else 'ies'} removed, "
+              f"{len(kept)} kept "
+              f"-> {os.path.relpath(baseline_path, root)}")
+        return 0
 
     if args.baseline_update:
         if report.errors:

@@ -1,0 +1,1035 @@
+"""Whole-program concurrency analysis: lockset inference + lock-order graph.
+
+The stack runs ~30 lock-owning threads (batcher dispatch, decode scheduler,
+frontend fan-outs, autoscaler, launchers, broker, fleet collectors, per-mesh
+run locks); two concurrency bugs have already shipped — the PR 1 streaming
+served-counter data race and the PR 16 mesh collective-rendezvous deadlock.
+This module is the graftlint chapter for that bug class, in the spirit of
+lockset/happens-before analyses (Eraser, ThreadSanitizer), scaled down to a
+zero-setup AST pass:
+
+* ``ClassModel`` / ``MethodSummary`` — per-class lockset inference. A small
+  abstract interpreter walks every method simulating the held-lock set
+  through ``with self._lock:`` blocks, ``acquire()``/``release()`` pairs
+  (including the try/finally form), and re-entry; every ``self.attr``
+  access, intra-class call, cross-class call through a typed attribute, and
+  known-blocking call is recorded with the lockset held at that point.
+  Locksets propagate through intra-class calls: a private helper inherits
+  the *intersection* of the locksets at its call sites, and a lock passed
+  as an argument (``self._helper(self._lock)`` … ``with lock:``) resolves
+  back to the caller's lock attribute when every call site agrees.
+* A repo-wide class index (built once per analysis run via the
+  ``Rule.begin_program`` hook and shared by every rule below) resolves
+  ``self.x = SomeClass(...)`` attributes to their class models, giving the
+  approximate cross-class call graph and the *static lock-acquisition-order
+  graph* across modules.
+
+Rules on top of the shared model (RULES.md has the bug-history rationale):
+
+* **GL003 lock-guard** — the declared-intent channel: ``# guarded by:
+  self._lock`` annotations are checked against the inferred locksets
+  (moved here from rules.py so annotation checking and inference share ONE
+  model). ``# guarded by: none`` declares an attribute deliberately
+  unguarded, silencing GL018.
+* **GL018 unguarded-shared-write** — GL003 generalized from opt-in
+  annotations to inference: an attribute written under a lock in one
+  method but accessed lock-free in another is flagged without any
+  annotation.
+* **GL019 blocking-under-lock** — sleep/subprocess/socket/urlopen/HTTP/
+  ``queue.get``/``Thread.join``/``block_until_ready`` reachable while a
+  lock is held (the PR 16 deadlock shape and the PR 2
+  snapshot-sorting-under-lock shape), propagated through intra-class calls
+  and one level of cross-class calls.
+* **GL020 lock-order-inversion** — cycles in the acquisition-order graph,
+  reported at every edge of the cycle so both acquisition paths show up;
+  re-acquiring a non-reentrant lock is the length-1 cycle.
+
+Everything here is stdlib-only (ast) — the jax-free graftlint entry imports
+this module, and the whole-repo pass must stay inside the lint gate's
+seconds-level budget.
+"""
+from __future__ import annotations
+
+import ast
+import dataclasses
+import re
+
+from .core import Rule, register
+from .rules import call_qual, is_self_attr, qualname
+
+# ---------------------------------------------------------------------------
+# classification tables
+# ---------------------------------------------------------------------------
+
+_LOCK_FACTORIES = {
+    "threading.Lock": "Lock",
+    "threading.RLock": "RLock",
+    "threading.Condition": "Condition",
+    "threading.Semaphore": "Semaphore",
+    "threading.BoundedSemaphore": "Semaphore",
+}
+_QUEUE_CLASSES = {"Queue", "LifoQueue", "PriorityQueue", "JoinableQueue",
+                  "MagicQueue"}
+_THREAD_CLASSES = {"Thread"}
+
+#: calls that park the calling thread (or dispatch to a device and wait):
+#: exact quals, plus prefix families checked in _blocking_qual()
+_BLOCKING_QUALS = {"time.sleep", "urllib.request.urlopen",
+                   "jax.block_until_ready"}
+_BLOCKING_PREFIXES = ("subprocess.", "socket.")
+#: util.http helpers — blocking network round-trips wherever imported from
+_BLOCKING_HTTP_NAMES = {"post_json", "get_json"}
+
+# annotation channel (shared with GL003's historical syntax):
+#   self._value = 0    # guarded by: self._lock
+#   self._cache = {}   # guarded by: none   <- deliberately unguarded (GL018)
+_GUARDED_RE = re.compile(r"#\s*guarded by:\s*(?:self\.([A-Za-z_]\w*)|(none))")
+
+
+def _blocking_qual(qual):
+    """Human-readable description if `qual` names a known-blocking call."""
+    if qual is None:
+        return None
+    if qual in _BLOCKING_QUALS:
+        return qual
+    if qual.startswith(_BLOCKING_PREFIXES):
+        return qual
+    last = qual.rsplit(".", 1)[-1]
+    if last in _BLOCKING_HTTP_NAMES and ".http" in qual:
+        return last
+    return None
+
+
+# ---------------------------------------------------------------------------
+# per-class model
+# ---------------------------------------------------------------------------
+
+# held-lockset tokens: ("attr", name) for self.<name>, ("param", name) for a
+# lock received as an argument (resolved back to the caller's attribute when
+# every intra-class call site agrees — see ClassModel._resolve_bindings),
+# ("ext", "var.attr") for a lock-named attribute of a local (`with
+# ctx.run_lock:` — the PR 16 mesh shape, where the lock lives on another
+# object). Ext locks count for blocking-under-lock but stay out of the
+# order graph (their identity is a variable name, not a class attribute).
+_UNKNOWN = "?"          # a held lock we can't name (still counts as "a lock")
+
+_LOCKISH_NAME = re.compile(r"lock|mutex|\bcv\b|cond", re.IGNORECASE)
+
+
+@dataclasses.dataclass
+class _Access:
+    attr: str
+    write: bool
+    tokens: frozenset       # raw held tokens at the access
+    node: object
+    held: frozenset = frozenset()   # resolved names, filled by finalize()
+
+
+@dataclasses.dataclass
+class _Acquire:
+    lock: str               # lock attribute being acquired
+    before: frozenset       # raw held tokens just before the acquire
+    node: object
+    held_before: frozenset = frozenset()
+
+
+@dataclasses.dataclass
+class _CallSite:
+    kind: str               # "self" | "attr"
+    attr: str               # receiver attribute ("" for self-calls)
+    method: str
+    tokens: frozenset
+    node: object
+    args: tuple             # positional arg AST nodes (self-calls only)
+    keywords: tuple         # (name, node) pairs
+    held: frozenset = frozenset()
+
+
+@dataclasses.dataclass
+class _Blocking:
+    desc: str
+    tokens: frozenset
+    node: object
+    held: frozenset = frozenset()
+    ext: frozenset = frozenset()    # held ext-lock display names
+
+
+@dataclasses.dataclass
+class MethodSummary:
+    name: str
+    node: object
+    params: tuple = ()
+    accesses: list = dataclasses.field(default_factory=list)
+    acquires: list = dataclasses.field(default_factory=list)
+    calls: list = dataclasses.field(default_factory=list)
+    blocking: list = dataclasses.field(default_factory=list)
+    # filled by ClassModel.finalize():
+    inherited: frozenset = frozenset()   # locks held at EVERY call site
+    bindings: dict = dataclasses.field(default_factory=dict)
+    blocks_all: tuple = ()               # transitive blocking descs
+    acquires_all: frozenset = frozenset()  # transitive lock attrs acquired
+
+
+class _MethodWalker:
+    """Simulates the held-lock set through one method body, recording every
+    attribute access / call / acquire / blocking event with the lockset at
+    that point. Nested function bodies (closures handed to threads, timers,
+    fan-outs) are walked with an EMPTY lockset — they run later, usually on
+    another thread, so a lock held at definition time guards nothing."""
+
+    def __init__(self, model, summary):
+        self.model = model
+        self.s = summary
+        self.held = []              # token stack (duplicates = re-entry)
+        self.thread_vars = set()    # locals bound to threading.Thread(...)
+        self.thread_lists = set()   # locals bound to lists of threads
+
+    # -- public entry --------------------------------------------------------
+    def walk(self, fn_node):
+        self.s.params = tuple(a.arg for a in fn_node.args.args
+                              if a.arg != "self")
+        self._stmts(fn_node.body)
+
+    def _tokens(self):
+        return frozenset(self.held)
+
+    # -- statements ----------------------------------------------------------
+    def _stmts(self, body):
+        for stmt in body:
+            self._stmt(stmt)
+
+    def _stmt(self, stmt):
+        if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            self._deferred(stmt.body)
+        elif isinstance(stmt, ast.ClassDef):
+            self._deferred(stmt.body)
+        elif isinstance(stmt, (ast.With, ast.AsyncWith)):
+            self._with(stmt)
+        elif isinstance(stmt, ast.Try):
+            # body/handlers/else/finally share ONE evolving lockset: this is
+            # exactly what makes `L.acquire(); try: ... finally: L.release()`
+            # come out right
+            self._stmts(stmt.body)
+            for h in stmt.handlers:
+                self._stmts(h.body)
+            self._stmts(stmt.orelse)
+            self._stmts(stmt.finalbody)
+        elif isinstance(stmt, ast.If):
+            self._expr(stmt.test)
+            self._branch(stmt.body)
+            self._branch(stmt.orelse)
+        elif isinstance(stmt, (ast.For, ast.AsyncFor)):
+            self._expr(stmt.iter)
+            if isinstance(stmt.target, ast.Name) and \
+                    isinstance(stmt.iter, ast.Name) and \
+                    stmt.iter.id in self.thread_lists:
+                self.thread_vars.add(stmt.target.id)
+            self._expr(stmt.target, write=True)
+            self._branch(stmt.body)
+            self._branch(stmt.orelse)
+        elif isinstance(stmt, ast.While):
+            self._expr(stmt.test)
+            self._branch(stmt.body)
+            self._branch(stmt.orelse)
+        elif isinstance(stmt, ast.Assign):
+            self._expr(stmt.value)
+            self._track_locals(stmt)
+            for t in stmt.targets:
+                self._expr(t, write=True)
+        elif isinstance(stmt, ast.AugAssign):
+            self._expr(stmt.value)
+            self._expr(stmt.target, write=True)
+        elif isinstance(stmt, ast.AnnAssign):
+            if stmt.value is not None:
+                self._expr(stmt.value)
+            self._expr(stmt.target, write=True)
+        elif isinstance(stmt, ast.Return):
+            if stmt.value is not None:
+                self._expr(stmt.value)
+        elif isinstance(stmt, (ast.Expr, ast.Await)):
+            self._expr(stmt.value)
+        elif isinstance(stmt, ast.Raise):
+            for part in (stmt.exc, stmt.cause):
+                if part is not None:
+                    self._expr(part)
+        elif isinstance(stmt, ast.Assert):
+            self._expr(stmt.test)
+            if stmt.msg is not None:
+                self._expr(stmt.msg)
+        elif isinstance(stmt, ast.Delete):
+            for t in stmt.targets:
+                self._expr(t, write=True)
+        # Pass/Break/Continue/Import/Global/Nonlocal: nothing to see
+
+    def _branch(self, body):
+        """Walk a conditional/loop body on a COPY of the lockset: an acquire
+        inside one branch must not leak into code after the statement."""
+        saved = list(self.held)
+        self._stmts(body)
+        self.held = saved
+
+    def _deferred(self, body):
+        """Nested function/class body: empty lockset, same summary."""
+        saved, self.held = self.held, []
+        self._stmts(body)
+        self.held = saved
+
+    def _with(self, stmt):
+        pushed = []
+        for item in stmt.items:
+            ce = item.context_expr
+            tok = self._lock_token(ce)
+            if tok is not None:
+                if tok[0] == "attr":
+                    self.s.acquires.append(
+                        _Acquire(tok[1], self._tokens(), ce))
+                self.held.append(tok)
+                pushed.append(tok)
+            else:
+                self._expr(ce)
+            if item.optional_vars is not None:
+                self._expr(item.optional_vars, write=True)
+        self._stmts(stmt.body)
+        for tok in pushed:
+            self.held.remove(tok)
+
+    def _lock_token(self, expr):
+        """Token for `with <expr>:` when <expr> is a lock we can name."""
+        if is_self_attr(expr):
+            return ("attr", expr.attr)
+        if isinstance(expr, ast.Name) and expr.id in self.s.params:
+            return ("param", expr.id)
+        if isinstance(expr, ast.Attribute) and \
+                isinstance(expr.value, ast.Name) and \
+                _LOCKISH_NAME.search(expr.attr):
+            # `with ctx.run_lock:` — a lock living on another object
+            return ("ext", f"{expr.value.id}.{expr.attr}")
+        return None
+
+    def _track_locals(self, assign):
+        """x = threading.Thread(...) / x = [Thread(...) ...] for .join()."""
+        if len(assign.targets) != 1 or \
+                not isinstance(assign.targets[0], ast.Name):
+            return
+        name = assign.targets[0].id
+        v = assign.value
+        if self._is_thread_call(v):
+            self.thread_vars.add(name)
+        elif isinstance(v, (ast.List, ast.ListComp)):
+            elts = v.elts if isinstance(v, ast.List) else [v.elt]
+            if any(self._is_thread_call(e) for e in elts):
+                self.thread_lists.add(name)
+
+    def _is_thread_call(self, node):
+        if not isinstance(node, ast.Call):
+            return False
+        qual = call_qual(node, self.model.aliases)
+        if qual == "threading.Thread":
+            return True
+        return (isinstance(node.func, ast.Name)
+                and node.func.id in _THREAD_CLASSES)
+
+    # -- expressions ---------------------------------------------------------
+    def _expr(self, node, write=False):
+        if node is None:
+            return
+        if isinstance(node, ast.Attribute):
+            if is_self_attr(node):
+                self._access(node, write)
+                return
+            self._expr(node.value, write=False)
+            return
+        if isinstance(node, ast.Call):
+            self._call(node)
+            return
+        if isinstance(node, (ast.Lambda,)):
+            self._deferred([ast.Expr(value=node.body)])
+            return
+        if isinstance(node, ast.Subscript):
+            # self.x[k] = v mutates the structure behind x: count the write
+            self._expr(node.value, write=write)
+            self._expr(node.slice, write=False)
+            return
+        if isinstance(node, (ast.Tuple, ast.List, ast.Set)):
+            for e in node.elts:
+                self._expr(e, write=write)
+            return
+        if isinstance(node, ast.Starred):
+            self._expr(node.value, write=write)
+            return
+        for child in ast.iter_child_nodes(node):
+            self._expr(child, write=False)
+
+    def _access(self, node, write):
+        self.s.accesses.append(
+            _Access(node.attr, write, self._tokens(), node))
+
+    def _call(self, node):
+        func = node.func
+        # self.L.acquire() / self.L.release()
+        if isinstance(func, ast.Attribute) and is_self_attr(func.value):
+            recv = func.value.attr
+            meth = func.attr
+            self._access(func.value, False)
+            if meth == "acquire":
+                self.s.acquires.append(
+                    _Acquire(recv, self._tokens(), node))
+                self.held.append(("attr", recv))
+            elif meth == "release":
+                tok = ("attr", recv)
+                if tok in self.held:
+                    self.held.remove(tok)
+            elif meth == "block_until_ready":
+                self.s.blocking.append(
+                    _Blocking("block_until_ready()", self._tokens(), node))
+            elif recv in self.model.queues and meth in ("get", "put", "join"):
+                self.s.blocking.append(_Blocking(
+                    f"self.{recv}.{meth}()", self._tokens(), node))
+            elif recv in self.model.threads and meth == "join":
+                self.s.blocking.append(_Blocking(
+                    f"self.{recv}.join()", self._tokens(), node))
+            elif meth in ("wait", "wait_for", "notify", "notify_all"):
+                pass    # Condition.wait releases the lock it waits on
+            else:
+                self.s.calls.append(_CallSite(
+                    "attr", recv, meth, self._tokens(), node,
+                    tuple(node.args),
+                    tuple((kw.arg, kw.value) for kw in node.keywords)))
+        elif isinstance(func, ast.Attribute) and \
+                isinstance(func.value, ast.Name) and func.value.id == "self":
+            self.s.calls.append(_CallSite(
+                "self", "", func.attr, self._tokens(), node,
+                tuple(node.args),
+                tuple((kw.arg, kw.value) for kw in node.keywords)))
+        else:
+            qual = qualname(func, self.model.aliases) \
+                if isinstance(func, (ast.Name, ast.Attribute)) else None
+            desc = _blocking_qual(qual)
+            if desc is not None:
+                self.s.blocking.append(
+                    _Blocking(f"{desc}()", self._tokens(), node))
+            elif isinstance(func, ast.Attribute):
+                if func.attr == "block_until_ready":
+                    self.s.blocking.append(_Blocking(
+                        "block_until_ready()", self._tokens(), node))
+                elif func.attr == "join" and isinstance(func.value, ast.Name) \
+                        and func.value.id in self.thread_vars:
+                    self.s.blocking.append(_Blocking(
+                        f"{func.value.id}.join()", self._tokens(), node))
+            self._expr(func)
+        for arg in node.args:
+            self._method_ref(arg)
+            self._expr(arg)
+        for kw in node.keywords:
+            self._method_ref(kw.value)
+            self._expr(kw.value)
+
+    def _method_ref(self, arg):
+        """A bare `self._method` passed as an argument (retry wrappers,
+        callbacks) counts as a call site for inherited-lockset intersection:
+        `self._retry.call(self._attempt, ...)` under the lock means _attempt
+        runs under the lock. Deferred references (Thread targets) are passed
+        at lock-free sites, so the intersection stays empty there."""
+        if is_self_attr(arg):
+            self.s.calls.append(_CallSite(
+                "ref", "", arg.attr, self._tokens(), arg, (), ()))
+
+
+class ClassModel:
+    """Lockset model for one class: lock attributes, typed attributes, the
+    guarded-by annotation channel, and a MethodSummary per direct method."""
+
+    EXEMPT_METHODS = {"__init__", "__del__"}
+
+    def __init__(self, ctx, node):
+        self.ctx = ctx
+        self.name = node.name
+        self.node = node
+        self.aliases = ctx.aliases
+        self.locks = {}         # attr -> "Lock"/"RLock"/"Condition"/...
+        self.queues = set()
+        self.threads = set()
+        self.attr_types = {}    # attr -> class basename of its constructor
+        self.guarded = {}       # attr -> (lock_attr, decl_line)
+        self.declared_unguarded = set()   # `# guarded by: none`
+        self.methods = {}       # name -> MethodSummary
+        self._classify_attrs()
+        self._scan_annotations()
+        for meth in node.body:
+            if isinstance(meth, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                s = MethodSummary(meth.name, meth)
+                _MethodWalker(self, s).walk(meth)
+                self.methods[meth.name] = s
+        self._finalize()
+
+    # -- model construction --------------------------------------------------
+    def _classify_attrs(self):
+        for node in ast.walk(self.node):
+            if isinstance(node, ast.Assign):
+                targets, value = node.targets, node.value
+            elif isinstance(node, ast.AnnAssign):
+                targets, value = [node.target], node.value
+            else:
+                continue
+            if not isinstance(value, ast.Call):
+                continue
+            qual = call_qual(value, self.aliases)
+            base = value.func.id if isinstance(value.func, ast.Name) \
+                else (value.func.attr
+                      if isinstance(value.func, ast.Attribute) else None)
+            for t in targets:
+                if not is_self_attr(t):
+                    continue
+                if qual in _LOCK_FACTORIES:
+                    self.locks[t.attr] = _LOCK_FACTORIES[qual]
+                elif base in _QUEUE_CLASSES or (
+                        qual or "").startswith("queue."):
+                    self.queues.add(t.attr)
+                elif qual == "threading.Thread" or base in _THREAD_CLASSES:
+                    self.threads.add(t.attr)
+                elif base is not None and base[:1].isupper():
+                    self.attr_types[t.attr] = base
+
+    def _scan_annotations(self):
+        end = getattr(self.node, "end_lineno", self.node.lineno)
+        for lineno in range(self.node.lineno, end + 1):
+            m = _GUARDED_RE.search(self.ctx.line_text(lineno))
+            if not m:
+                continue
+            attr = self._annotated_attr(lineno)
+            if attr is None:
+                continue
+            if m.group(2):              # guarded by: none
+                self.declared_unguarded.add(attr)
+            else:
+                self.guarded[attr] = (m.group(1), lineno)
+
+    def _annotated_attr(self, lineno):
+        """self.<attr> assigned on (a line of) the annotated statement — the
+        annotation may sit on any line of a multi-line declaration."""
+        for node in ast.walk(self.node):
+            if isinstance(node, (ast.Assign, ast.AnnAssign, ast.AugAssign)) \
+                    and node.lineno <= lineno \
+                    <= getattr(node, "end_lineno", node.lineno):
+                targets = node.targets if isinstance(node, ast.Assign) \
+                    else [node.target]
+                for t in targets:
+                    if is_self_attr(t):
+                        return t.attr
+        return None
+
+    # -- lockset propagation -------------------------------------------------
+    def _finalize(self):
+        self._resolve_bindings()
+        self._propagate_inherited()
+        for s in self.methods.values():
+            for a in s.accesses:
+                a.held = self._resolve(s, a.tokens) | s.inherited
+            for ac in s.acquires:
+                ac.held_before = self._resolve_attrs(ac.before)
+            for c in s.calls:
+                c.held = self._resolve_attrs(c.tokens)
+            for b in s.blocking:
+                b.held = self._resolve_attrs(b.tokens)
+                b.ext = frozenset(n for k, n in b.tokens if k == "ext")
+        self._propagate_blocking()
+        self._propagate_acquires()
+
+    def _resolve(self, summary, tokens):
+        """Raw tokens -> lock names; a param-lock that doesn't resolve still
+        counts as holding *a* lock (`?`) — the access isn't lock-free."""
+        out = set()
+        for kind, name in tokens:
+            if kind == "attr":
+                out.add(name)
+            else:
+                out.add(summary.bindings.get(name, _UNKNOWN))
+        return frozenset(out)
+
+    @staticmethod
+    def _resolve_attrs(tokens):
+        """Attribute-held locks only (order graph + blocking reports name
+        real locks; param locks stay out of the cross-method graphs)."""
+        return frozenset(n for k, n in tokens if k == "attr")
+
+    def _call_sites(self, name):
+        for s in self.methods.values():
+            for c in s.calls:
+                if c.kind in ("self", "ref") and c.method == name:
+                    yield s, c
+
+    def _resolve_bindings(self):
+        """param name -> caller lock attr, when EVERY intra-class call site
+        passes the same `self.<lock>` for that parameter."""
+        for name, s in self.methods.items():
+            bound = {}
+            for caller, c in self._call_sites(name):
+                for i, p in enumerate(s.params):
+                    arg = c.args[i] if i < len(c.args) else \
+                        next((v for k, v in c.keywords if k == p), None)
+                    if arg is None:
+                        continue
+                    lock = arg.attr if (is_self_attr(arg)
+                                        and arg.attr in self.locks) else None
+                    prev = bound.get(p, lock)
+                    bound[p] = lock if lock == prev else None
+            s.bindings = {p: l for p, l in bound.items() if l}
+
+    def _propagate_inherited(self):
+        """Private helpers inherit the intersection of the locksets held at
+        their intra-class call sites; public methods assume external callers
+        (no locks). Bounded fixpoint over the intra-class call graph."""
+        private = [n for n in self.methods
+                   if n.startswith("_") and not n.startswith("__")]
+        inh = {n: (None if n in private else frozenset())
+               for n in self.methods}
+        for _ in range(len(self.methods) + 1):
+            changed = False
+            for name in private:
+                sites = list(self._call_sites(name))
+                if not sites:
+                    new = frozenset()
+                else:
+                    vals = []
+                    for caller, c in sites:
+                        base = inh[caller.name]
+                        if base is None:
+                            continue
+                        vals.append(self._resolve_attrs(c.tokens) | base)
+                    if not vals:
+                        continue
+                    new = frozenset.intersection(*vals)
+                if new != inh[name]:
+                    inh[name] = new
+                    changed = True
+            if not changed:
+                break
+        for name, s in self.methods.items():
+            s.inherited = inh[name] or frozenset()
+
+    def _propagate_blocking(self):
+        """blocks_all: every blocking desc reachable through intra-class
+        calls (regardless of locks — the caller's lockset decides)."""
+        blocks = {n: {b.desc for b in s.blocking}
+                  for n, s in self.methods.items()}
+        for _ in range(len(self.methods) + 1):
+            changed = False
+            for n, s in self.methods.items():
+                for c in s.calls:
+                    if c.kind == "self" and c.method in blocks:
+                        add = blocks[c.method] - blocks[n]
+                        if add:
+                            blocks[n] |= add
+                            changed = True
+            if not changed:
+                break
+        for n, s in self.methods.items():
+            s.blocks_all = tuple(sorted(blocks[n]))
+
+    def _propagate_acquires(self):
+        """acquires_all: every lock attr acquired through intra-class calls."""
+        acq = {n: {a.lock for a in s.acquires if a.lock in self.locks}
+               for n, s in self.methods.items()}
+        for _ in range(len(self.methods) + 1):
+            changed = False
+            for n, s in self.methods.items():
+                for c in s.calls:
+                    if c.kind == "self" and c.method in acq:
+                        add = acq[c.method] - acq[n]
+                        if add:
+                            acq[n] |= add
+                            changed = True
+            if not changed:
+                break
+        for n, s in self.methods.items():
+            s.acquires_all = frozenset(acq[n])
+
+
+# ---------------------------------------------------------------------------
+# program model (built once per analysis run, shared through the rule cache)
+# ---------------------------------------------------------------------------
+
+
+def file_models(ctx):
+    """ClassModel for every class in one file."""
+    return [ClassModel(ctx, node) for node in ctx.nodes
+            if isinstance(node, ast.ClassDef)]
+
+
+def get_program(contexts, cache):
+    """The whole-program index: per-file class models plus a global
+    name -> model map (ambiguous basenames resolve to None). Memoized in
+    the per-run rule cache so GL003/GL018/GL019/GL020 build it once."""
+    prog = cache.get("concurrency")
+    if prog is not None:
+        return prog
+    files, classes = {}, {}
+    for ctx in contexts:
+        models = file_models(ctx)
+        files[ctx.rel_path] = models
+        for m in models:
+            classes[m.name] = None if m.name in classes else m
+    prog = {"files": files, "classes": classes}
+    cache["concurrency"] = prog
+    return prog
+
+
+class _ConcurrencyRule(Rule):
+    """Base: binds the shared program model before per-file checks."""
+
+    def __init__(self):
+        self._program = None
+
+    def begin_program(self, contexts, cache):
+        self._program = get_program(contexts, cache)
+
+    def models(self, ctx):
+        if self._program is None:      # direct rule.check() use in tests
+            self._program = {"files": {}, "classes": {}}
+        models = self._program["files"].get(ctx.rel_path)
+        if models is None:
+            models = file_models(ctx)
+            self._program["files"][ctx.rel_path] = models
+        return models
+
+    def resolve_class(self, model, attr):
+        """ClassModel behind `self.<attr>`, if its constructor basename maps
+        to exactly one class in the program."""
+        base = model.attr_types.get(attr)
+        if base is None:
+            return None
+        return self._program["classes"].get(base)
+
+
+# ---------------------------------------------------------------------------
+# GL003 — lock-guard (annotation channel, now on the inferred lockset model)
+# ---------------------------------------------------------------------------
+
+
+@register
+class LockGuardRule(_ConcurrencyRule):
+    """Attributes annotated `# guarded by: self._lock` touched off-lock."""
+
+    id = "GL003"
+    name = "lock-guard"
+    rationale = (
+        "Shared mutable state documented as lock-guarded but read/written "
+        "outside a `with self._lock:` block is a data race (the served-"
+        "counter lost-update bug). The annotation makes the invariant "
+        "machine-checked: declare it once where the attribute is "
+        "initialized, and every off-lock access in the class is flagged — "
+        "checked against the same inferred locksets GL018 uses, so helper "
+        "methods called under the lock (or handed the lock) count as "
+        "guarded. __init__/__del__ are exempt (no concurrent callers exist "
+        "yet/still).")
+
+    def check(self, ctx):
+        for model in self.models(ctx):
+            if not model.guarded:
+                continue
+            for name, s in model.methods.items():
+                if name in model.EXEMPT_METHODS:
+                    continue
+                for a in s.accesses:
+                    if a.attr not in model.guarded:
+                        continue
+                    lock, decl_line = model.guarded[a.attr]
+                    if a.node.lineno == decl_line or lock in a.held:
+                        continue
+                    yield self.violation(
+                        ctx, a.node,
+                        f"self.{a.attr} is guarded by self.{lock} but "
+                        f"accessed outside a `with self.{lock}:` block")
+
+
+# ---------------------------------------------------------------------------
+# GL018 — unguarded-shared-write (annotation-free lockset inference)
+# ---------------------------------------------------------------------------
+
+
+@register
+class UnguardedSharedWriteRule(_ConcurrencyRule):
+    """Attr written under a lock in one method, accessed lock-free in another."""
+
+    id = "GL018"
+    name = "unguarded-shared-write"
+    rationale = (
+        "An attribute written inside `with self._lock:` in one method is "
+        "shared mutable state by declaration-of-behavior; touching it "
+        "lock-free in another method of the same class is the PR 1 "
+        "served-counter race without the annotation. GL003 generalized "
+        "from opt-in annotations to inference — annotate `# guarded by: "
+        "self.<lock>` to route it through GL003, or `# guarded by: none` "
+        "to declare it deliberately unguarded.")
+
+    def check(self, ctx):
+        for model in self.models(ctx):
+            if not model.locks:
+                continue
+            skip = (set(model.locks) | model.queues | model.threads
+                    | set(model.guarded) | model.declared_unguarded)
+            locked_writers = {}   # attr -> (method, lock) first locked write
+            for name, s in model.methods.items():
+                if name in model.EXEMPT_METHODS:
+                    continue
+                for a in s.accesses:
+                    if a.attr in skip or not a.write or not a.held:
+                        continue
+                    lock = next((h for h in sorted(a.held)
+                                 if h in model.locks), None)
+                    if lock is None:
+                        continue
+                    locked_writers.setdefault(a.attr, (name, lock))
+            if not locked_writers:
+                continue
+            write_methods = {}    # attr -> set of methods with locked writes
+            for name, s in model.methods.items():
+                for a in s.accesses:
+                    if a.attr in locked_writers and a.write and a.held:
+                        write_methods.setdefault(a.attr, set()).add(name)
+            for name, s in model.methods.items():
+                if name in model.EXEMPT_METHODS:
+                    continue
+                for a in s.accesses:
+                    if a.attr not in locked_writers or a.held:
+                        continue
+                    if name in write_methods.get(a.attr, ()):
+                        continue
+                    w_meth, lock = locked_writers[a.attr]
+                    yield self.violation(
+                        ctx, a.node,
+                        f"self.{a.attr} is written under self.{lock} in "
+                        f"{w_meth}() but accessed lock-free here; hold the "
+                        f"lock, or annotate the attribute `# guarded by: "
+                        f"self.{lock}` / `# guarded by: none`")
+
+
+# ---------------------------------------------------------------------------
+# GL019 — blocking-under-lock
+# ---------------------------------------------------------------------------
+
+
+@register
+class BlockingUnderLockRule(_ConcurrencyRule):
+    """sleep/socket/subprocess/queue/join/device-sync while holding a lock."""
+
+    id = "GL019"
+    name = "blocking-under-lock"
+    rationale = (
+        "A blocking call under a lock turns one slow peer into a stalled "
+        "process: every thread that needs the lock parks behind a network "
+        "round-trip, a queue wait, or a device sync — the PR 16 mesh "
+        "rendezvous deadlock (device wait under the run lock) and the PR 2 "
+        "percentile-sort-under-the-metrics-lock stall both had this shape. "
+        "Copy state out under the lock, block outside it; intentional "
+        "holds (e.g. the mesh run lock serializing collective waves) are "
+        "baselined with a note.")
+
+    def check(self, ctx):
+        for model in self.models(ctx):
+            for name, s in model.methods.items():
+                for b in s.blocking:
+                    locks = sorted(h for h in b.held if h in model.locks)
+                    if locks:
+                        yield self.violation(
+                            ctx, b.node,
+                            f"{b.desc} blocks while holding "
+                            f"self.{locks[0]}")
+                    elif b.ext:
+                        yield self.violation(
+                            ctx, b.node,
+                            f"{b.desc} blocks while holding "
+                            f"{sorted(b.ext)[0]}")
+                if not model.locks:
+                    continue
+                for c in s.calls:
+                    locks = sorted(h for h in c.held if h in model.locks)
+                    if not locks:
+                        continue
+                    target = None
+                    if c.kind == "self":
+                        target = model.methods.get(c.method)
+                        label = f"self.{c.method}()"
+                    else:
+                        other = self.resolve_class(model, c.attr)
+                        if other is not None:
+                            target = other.methods.get(c.method)
+                        label = f"self.{c.attr}.{c.method}()"
+                    if target is not None and target.blocks_all:
+                        yield self.violation(
+                            ctx, c.node,
+                            f"{label} reaches blocking "
+                            f"{target.blocks_all[0]} while holding "
+                            f"self.{locks[0]}")
+
+
+# ---------------------------------------------------------------------------
+# GL020 — lock-order-inversion
+# ---------------------------------------------------------------------------
+
+
+@register
+class LockOrderInversionRule(_ConcurrencyRule):
+    """Cycles in the static lock-acquisition-order graph."""
+
+    id = "GL020"
+    name = "lock-order-inversion"
+    rationale = (
+        "Two threads acquiring the same pair of locks in opposite orders "
+        "deadlock the process the first time their schedules interleave — "
+        "the bug class behind the PR 16 mesh run-lock freeze. The "
+        "acquisition-order graph (lock A held while B is acquired => edge "
+        "A->B, across intra-class helpers and typed-attribute calls) must "
+        "stay acyclic; every edge of a cycle is reported so both "
+        "acquisition paths are visible. Re-acquiring a non-reentrant lock "
+        "is the length-1 cycle.")
+
+    def begin_program(self, contexts, cache):
+        super().begin_program(contexts, cache)
+        if "lock_order" not in cache:
+            cache["lock_order"] = self._build(self._program)
+        self._cycle_edges = cache["lock_order"]
+
+    def __init__(self):
+        super().__init__()
+        self._cycle_edges = None
+
+    def _build(self, prog):
+        edges = []   # (src_lockid, dst_lockid, rel_path, node, label)
+        for rel_path, models in prog["files"].items():
+            for model in models:
+                self._class_edges(model, rel_path, edges)
+        return self._cycles(edges)
+
+    def _class_edges(self, model, rel_path, edges):
+        def lock_id(m, attr):
+            return (m.name, attr)
+
+        for name, s in model.methods.items():
+            for ac in s.acquires:
+                if ac.lock not in model.locks:
+                    continue
+                dst = lock_id(model, ac.lock)
+                if ("attr", ac.lock) in ac.before and \
+                        model.locks[ac.lock] != "RLock":
+                    edges.append((dst, dst, rel_path, ac.node,
+                                  f"{model.name}.{name}() re-acquires "
+                                  f"non-reentrant self.{ac.lock}"))
+                for h in ac.held_before:
+                    if h in model.locks and h != ac.lock:
+                        edges.append((lock_id(model, h), dst, rel_path,
+                                      ac.node,
+                                      f"{model.name}.{name}() acquires "
+                                      f"self.{ac.lock} while holding "
+                                      f"self.{h}"))
+            for c in s.calls:
+                held = [h for h in c.held if h in model.locks]
+                if not held:
+                    continue
+                if c.kind == "self":
+                    target_model, target = model, model.methods.get(c.method)
+                    label = f"self.{c.method}()"
+                else:
+                    target_model = self.resolve_class(model, c.attr)
+                    target = target_model.methods.get(c.method) \
+                        if target_model is not None else None
+                    label = f"self.{c.attr}.{c.method}()"
+                if target is None:
+                    continue
+                for dst_attr in target.acquires_all:
+                    for h in held:
+                        if target_model is model and dst_attr == h:
+                            # same lock through a helper: a plain Lock
+                            # self-deadlocks; an RLock re-enters fine
+                            if model.locks[h] != "RLock":
+                                edges.append((
+                                    lock_id(model, h), lock_id(model, h),
+                                    rel_path, c.node,
+                                    f"{model.name}.{name}() holds self.{h} "
+                                    f"and {label} re-acquires non-reentrant "
+                                    f"self.{h}"))
+                            continue
+                        edges.append((
+                            lock_id(model, h),
+                            lock_id(target_model, dst_attr), rel_path,
+                            c.node,
+                            f"{model.name}.{name}() holds self.{h} and "
+                            f"{label} acquires "
+                            f"{target_model.name}.{dst_attr}"))
+
+    @staticmethod
+    def _cycles(edges):
+        """Edges that sit on a cycle (Tarjan SCC; self-loops included),
+        each annotated with a counter-path edge for the report."""
+        graph = {}
+        for src, dst, *_ in edges:
+            graph.setdefault(src, set()).add(dst)
+            graph.setdefault(dst, set())
+        index, low, on, stack, comp = {}, {}, set(), [], {}
+        counter = [0]
+
+        def strongconnect(v):
+            work = [(v, iter(sorted(graph[v])))]
+            index[v] = low[v] = counter[0]
+            counter[0] += 1
+            stack.append(v)
+            on.add(v)
+            while work:
+                node, it = work[-1]
+                advanced = False
+                for w in it:
+                    if w not in index:
+                        index[w] = low[w] = counter[0]
+                        counter[0] += 1
+                        stack.append(w)
+                        on.add(w)
+                        work.append((w, iter(sorted(graph[w]))))
+                        advanced = True
+                        break
+                    if w in on:
+                        low[node] = min(low[node], index[w])
+                if advanced:
+                    continue
+                work.pop()
+                if work:
+                    parent = work[-1][0]
+                    low[parent] = min(low[parent], low[node])
+                if low[node] == index[node]:
+                    while True:
+                        w = stack.pop()
+                        on.discard(w)
+                        comp[w] = node
+                        if w == node:
+                            break
+
+        for v in sorted(graph):
+            if v not in index:
+                strongconnect(v)
+        multi = {c for c in set(comp.values())
+                 if sum(1 for v in comp if comp[v] == c) > 1}
+        cyclic = []
+        for e in edges:
+            src, dst = e[0], e[1]
+            if src == dst or (comp.get(src) in multi
+                              and comp[src] == comp.get(dst)):
+                cyclic.append(e)
+        out = []
+        for e in cyclic:
+            src, dst, rel_path, node, label = e
+            counter_edge = next(
+                (o for o in cyclic
+                 if o is not e and o[0] == dst), None)
+            out.append((rel_path, node, label, counter_edge))
+        return out
+
+    def check(self, ctx):
+        for rel_path, node, label, counter_edge in (self._cycle_edges or ()):
+            if rel_path != ctx.rel_path:
+                continue
+            if counter_edge is None:
+                msg = f"lock-order inversion: {label} (self-deadlock)"
+            else:
+                _, _, c_path, c_node, c_label = counter_edge
+                msg = (f"lock-order inversion: {label}, but {c_label} "
+                       f"({c_path}:{c_node.lineno}) closes the cycle")
+            yield self.violation(ctx, node, msg)

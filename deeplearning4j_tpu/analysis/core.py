@@ -3,8 +3,11 @@
 Design notes
 ------------
 * Rules are pure functions of one parsed file (``FileContext``): source text,
-  AST, comment map, and light import resolution. Cross-file analysis is out of
-  scope — every invariant the codebase needs so far is intra-file.
+  AST, comment map, and light import resolution. Rules that need *program*
+  context (the concurrency pass) override ``Rule.begin_program``, which runs
+  once per analysis with every FileContext and a shared cache before any
+  per-file ``check`` — so whole-program indexes are built exactly once and
+  violations still report (and suppress, and baseline) per file.
 * Suppression is comment-driven, pylint-style but with a project-specific
   marker so it can never collide with other linters:
       x = time.time()          # graftlint: disable=GL001  <why it's OK>
@@ -187,6 +190,12 @@ class Rule:
     name = "abstract-rule"
     rationale = ""
 
+    def begin_program(self, contexts, cache):
+        """Called once per analysis run, before any check(), with EVERY
+        FileContext that will be checked plus a cache dict shared by all
+        rules in the run (so e.g. the concurrency model is built once even
+        though three rules consume it). Default: no program state."""
+
     def check(self, ctx: FileContext):
         raise NotImplementedError
 
@@ -239,18 +248,29 @@ class Analyzer:
         self.root = os.path.abspath(root or os.getcwd())
 
     def analyze_source(self, source, rel_path):
-        """Lint one in-memory source string; returns (violations, error)."""
+        """Lint one in-memory source string; returns (violations, error).
+        Program rules see a one-file program (their cross-file edges simply
+        don't exist), so seeded single-source tests still exercise them."""
         try:
             ctx = FileContext(source, rel_path)
         except (SyntaxError, ValueError) as e:
             return [], f"{rel_path}: {type(e).__name__}: {e}"
-        out = []
+        return self._check_contexts([ctx]), None
+
+    def _check_contexts(self, ctxs):
+        """One analysis run: program hooks once over every context, then the
+        per-file checks, suppression-filtered and sorted."""
+        cache = {}
         for rule in self.rules:
-            for v in rule.check(ctx):
-                if not ctx.suppressed(v.rule, v.line):
-                    out.append(v)
+            rule.begin_program(ctxs, cache)
+        out = []
+        for ctx in ctxs:
+            for rule in self.rules:
+                for v in rule.check(ctx):
+                    if not ctx.suppressed(v.rule, v.line):
+                        out.append(v)
         out.sort(key=lambda v: (v.path, v.line, v.col, v.rule))
-        return out, None
+        return out
 
     def analyze_file(self, path):
         rel = os.path.relpath(os.path.abspath(path), self.root)
@@ -275,21 +295,26 @@ class Analyzer:
                             yield os.path.join(dirpath, fn)
 
     def analyze_paths(self, paths) -> Report:
-        violations, errors, n = [], [], 0
+        errors, n = [], 0
         for p in paths:
             full = p if os.path.isabs(p) else os.path.join(self.root, p)
             if not os.path.exists(full):
                 # a typoed path in CI must fail loudly, not lint 0 files green
                 errors.append(f"{p}: path does not exist")
-        rel_files = []
+        # parse EVERY file first: program rules (lock-order, cross-class
+        # locksets) need the whole file set before any per-file check runs
+        rel_files, ctxs = [], []
         for path in self.iter_python_files(paths):
             n += 1
-            rel_files.append(os.path.relpath(os.path.abspath(path), self.root)
-                             .replace(os.sep, "/"))
-            vs, err = self.analyze_file(path)
-            violations.extend(vs)
-            if err is not None:
-                errors.append(err)
-        violations.sort(key=lambda v: (v.path, v.line, v.col, v.rule))
+            rel = (os.path.relpath(os.path.abspath(path), self.root)
+                   .replace(os.sep, "/"))
+            rel_files.append(rel)
+            try:
+                with open(path, encoding="utf-8") as f:
+                    source = f.read()
+                ctxs.append(FileContext(source, rel))
+            except (OSError, UnicodeDecodeError, SyntaxError, ValueError) as e:
+                errors.append(f"{rel}: {type(e).__name__}: {e}")
+        violations = self._check_contexts(ctxs)
         return Report(violations=violations, errors=errors, files_checked=n,
                       rel_files=rel_files)

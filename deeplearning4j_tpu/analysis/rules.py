@@ -203,92 +203,9 @@ class UnsafeJsonRule(Rule):
 
 
 # ---------------------------------------------------------------------------
-# GL003 — lock-guard
+# GL003 — lock-guard: moved to concurrency.py, where the annotation channel
+# is checked against the same inferred locksets GL018–GL020 use.
 # ---------------------------------------------------------------------------
-
-_GUARDED_RE = re.compile(r"#\s*guarded by:\s*self\.([A-Za-z_]\w*)")
-
-
-@register
-class LockGuardRule(Rule):
-    """Attributes annotated `# guarded by: self._lock` touched off-lock."""
-
-    id = "GL003"
-    name = "lock-guard"
-    rationale = (
-        "Shared mutable state documented as lock-guarded but read/written "
-        "outside a `with self._lock:` block is a data race (the served-"
-        "counter lost-update bug). The annotation makes the invariant "
-        "machine-checked: declare it once where the attribute is "
-        "initialized, and every off-lock access in the class is flagged. "
-        "__init__/__del__ are exempt (no concurrent callers exist yet/still).")
-
-    EXEMPT_METHODS = {"__init__", "__del__"}
-
-    def check(self, ctx):
-        annotations = [(i, m.group(1))
-                       for i, line in enumerate(ctx.lines, 1)
-                       for m in [_GUARDED_RE.search(line)] if m]
-        if not annotations:
-            return
-        for cls in ast.walk(ctx.tree):
-            if not isinstance(cls, ast.ClassDef):
-                continue
-            end = getattr(cls, "end_lineno", cls.lineno)
-            guarded = {}   # attr -> (lock_attr, decl_line)
-            for lineno, lock in annotations:
-                if not (cls.lineno <= lineno <= end):
-                    continue
-                attr = self._annotated_attr(cls, lineno)
-                if attr is not None:
-                    guarded[attr] = (lock, lineno)
-            if not guarded:
-                continue
-            for meth in cls.body:
-                if not isinstance(meth, (ast.FunctionDef,
-                                         ast.AsyncFunctionDef)):
-                    continue
-                if meth.name in self.EXEMPT_METHODS:
-                    continue
-                yield from self._check_method(ctx, meth, guarded)
-
-    @staticmethod
-    def _annotated_attr(cls, lineno):
-        """self.<attr> assigned on the annotated line (the declaration)."""
-        for node in ast.walk(cls):
-            if isinstance(node, (ast.Assign, ast.AnnAssign, ast.AugAssign)) \
-                    and node.lineno == lineno:
-                targets = node.targets if isinstance(node, ast.Assign) \
-                    else [node.target]
-                for t in targets:
-                    if is_self_attr(t):
-                        return t.attr
-        return None
-
-    def _check_method(self, ctx, meth, guarded):
-        for node in ast.walk(meth):
-            if not is_self_attr(node) or node.attr not in guarded:
-                continue
-            lock, decl_line = guarded[node.attr]
-            if node.lineno == decl_line:
-                continue
-            if self._under_lock(ctx, node, lock, stop_at=meth):
-                continue
-            yield self.violation(
-                ctx, node,
-                f"self.{node.attr} is guarded by self.{lock} but accessed "
-                f"outside a `with self.{lock}:` block")
-
-    @staticmethod
-    def _under_lock(ctx, node, lock, stop_at):
-        for anc in ctx.ancestors(node):
-            if isinstance(anc, ast.With):
-                for item in anc.items:
-                    if is_self_attr(item.context_expr, lock):
-                        return True
-            if anc is stop_at:
-                return False
-        return False
 
 
 # ---------------------------------------------------------------------------

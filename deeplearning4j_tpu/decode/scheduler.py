@@ -278,7 +278,8 @@ class DecodeScheduler:
     def start(self):
         if self._thread is not None and self._thread.is_alive():
             return self
-        self._closed = False
+        with self._work:        # _closed is guarded by the work condition
+            self._closed = False
         self._thread = threading.Thread(target=self._run, daemon=True,
                                         name="decode-scheduler")
         self._thread.start()

@@ -151,7 +151,9 @@ class AutoscaleController:
         # bounded action history, NEWEST kept: the operator-facing
         # status() view must show what just happened, not event #1000
         self.transitions = deque(maxlen=1000)
-        self.evaluations = 0
+        # display-only tick counter: /autoscaler readers take a bare int
+        # read instead of parking behind a full tick
+        self.evaluations = 0            # guarded by: none
         self._last_action = None           # monotonic_s of last scale action
         self._last_totals = {}             # replica -> (requests, shed)
         self._down_since = {}              # replica -> monotonic_s first down
@@ -382,11 +384,14 @@ class AutoscaleController:
 
     def evaluate(self):
         """One full tick: collect -> alert-evaluate -> act (cooldown- and
-        bound-gated). Returns a summary dict (assertable in tests/smoke)."""
+        bound-gated). Returns a summary dict (assertable in tests/smoke).
+        The signal sweep is per-replica network I/O and runs OUTSIDE the
+        tick lock: a wedged replica must cost this tick its timeout, not
+        park every other lock waiter behind a dead socket (GL019)."""
+        signals = self.collect_signals()
         with self._lock:
             self.evaluations += 1
             with self.tracer.span("autoscale", tick=self.evaluations):
-                signals = self.collect_signals()
                 self.alerts.evaluate()
                 states = {r.name: r.state for r in self.alerts.rules}
                 up_firing = [n for n in self._up_names

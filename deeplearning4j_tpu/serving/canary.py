@@ -110,13 +110,20 @@ class CanaryController:
 
     def _promote_ready(self):
         """Gauge callback: 1.0 when promotable, 0.0 while baking, None when
-        idle (no-data keeps the rule inactive between canaries)."""
-        if self.state != OBSERVING:
+        idle (no-data keeps the rule inactive between canaries). Runs on the
+        metrics-scrape thread, so the rollout state written by start() is
+        snapshotted under the lock (GL018), and the metric/alert reads stay
+        outside it."""
+        with self._lock:
+            state = self.state
+            started_mono = self._started_mono
+            attempts_at_start = self._attempts_at_start
+        if state != OBSERVING:
             return None
-        if monotonic_s() - self._started_mono < self.bake_s:
+        if monotonic_s() - started_mono < self.bake_s:
             return 0.0
         served = self.frontend.m_attempts.get(cohort="canary") \
-            - self._attempts_at_start
+            - attempts_at_start
         if served < self.min_requests:
             return 0.0
         for rule in self.frontend.alerts.rules:
@@ -187,8 +194,12 @@ class CanaryController:
 
     def _on_alert(self, event):
         """AlertEngine sink: the gate. Exactly-once transition events drive
-        the react step — no polling loop of our own."""
-        if self.state != OBSERVING or event.get("state") != "firing":
+        the react step — no polling loop of our own. The state read takes
+        the lock (alert-engine thread vs start()); rollback() re-acquires
+        it itself, so the reaction runs outside the critical section."""
+        with self._lock:
+            observing = self.state == OBSERVING
+        if not observing or event.get("state") != "firing":
             return
         rule = event.get("rule")
         if rule in _BREACH_RULES:
@@ -201,12 +212,16 @@ class CanaryController:
         The broadcast runs OUTSIDE the lock (PROMOTING reserves the
         controller against a concurrent rollback)."""
         with self._lock:
-            if self.state != OBSERVING:
-                return self.status()
-            self.state = PROMOTING
-            version, path = self.version, self.path
-            stable = [r for r in self.frontend.replicas
-                      if r.name != self.replica_name]
+            observing = self.state == OBSERVING
+            if observing:
+                self.state = PROMOTING
+                version, path = self.version, self.path
+                stable = [r for r in self.frontend.replicas
+                          if r.name != self.replica_name]
+        if not observing:
+            # status() takes the lock itself — calling it from inside the
+            # critical section self-deadlocks (graftlint GL020)
+            return self.status()
         body = {"version": version}
         if path is not None:
             body["path"] = path
@@ -230,11 +245,14 @@ class CanaryController:
         bad version at full weight. A later fleet-wide /deploy re-admits
         it; until then start() refuses a new canary over the wreckage."""
         with self._lock:
-            if self.state != OBSERVING:
-                return self.status()
-            self.state = ROLLING_BACK
-            version, replica = self.version, self.replica_name
-            target = self.frontend._replica(replica)
+            observing = self.state == OBSERVING
+            if observing:
+                self.state = ROLLING_BACK
+                version, replica = self.version, self.replica_name
+                target = self.frontend._replica(replica)
+        if not observing:
+            # as in promote(): status() re-acquires self._lock (GL020)
+            return self.status()
         from ..resilience.policy import RetryPolicy, advance_aware_sleep
         from ..util.http import post_json
         try:

@@ -176,9 +176,12 @@ class FleetFrontend(BackgroundHttpServer):
                                   window=breaker_window,
                                   min_calls=breaker_min_calls,
                                   open_for_s=breaker_open_for_s)
+        # Copy-on-write pool: writers serialize under _route_lock and REPLACE
+        # the list (never mutate in place), so lock-free readers iterate a
+        # consistent snapshot — the CPython list-reference idiom.
         self.replicas = [
             ReplicaHandle(n, u, self._make_breaker(n))
-            for n, u in zip(names, urls)]
+            for n, u in zip(names, urls)]   # guarded by: none
 
         self.health_interval_s = float(health_interval_s)
         self.health_timeout_s = float(health_timeout_s)

@@ -450,6 +450,11 @@ class ServingServer(BackgroundHttpServer):
     def start(self):
         if self._httpd is not None:
             return self            # already running: idempotent
+        # opt-in runtime lock monitoring ($GRAFT_LOCK_SANITIZER=1): a no-op
+        # (no patching, zero per-acquire overhead) unless the env var is
+        # set; state is served live at GET /debug/locks either way
+        from ..util.concurrency import lock_sanitizer
+        lock_sanitizer.install_from_env()
         if self.queue.closed:
             # stop()/start() cycle: a closed queue sheds everything forever
             # and its batcher thread has exited — rebuild both for resume,
@@ -527,6 +532,12 @@ class ServingServer(BackgroundHttpServer):
                     self.send_json(200, server.cost.to_dict(
                         sort=query.get("sort", "hbm_bytes_per_sample"),
                         family=query.get("family")), default=str)
+                elif u.path == "/debug/locks":
+                    # live lock-sanitizer state (installed flag, held-lock
+                    # sets, acquisition-order edges, violations); harmless
+                    # {"installed": false, ...} when the sanitizer is off
+                    from ..util.concurrency import lock_sanitizer
+                    self.send_json(200, lock_sanitizer.table(), default=str)
                 elif u.path == "/profile/trace":
                     # bounded on-demand capture: ?steps=N spans (hard
                     # iteration cap inside capture_trace — always stops,

@@ -205,16 +205,22 @@ class FleetCollector:
 
     def maybe_poll(self):
         """poll_once() if the cached data is older than `interval_s` (or
-        absent). The check-and-poll is serialized so concurrent fleet scrapes
-        trigger one peer sweep, not one per scrape; staleness reads the
-        injected clock, so ManualClock tests drive re-polls with no sleeps."""
+        absent). The check-and-claim is serialized so concurrent fleet scrapes
+        trigger one peer sweep, not one per scrape, but the sweep itself —
+        minutes of network I/O in the worst case — runs OUTSIDE the lock
+        (GL019): the winner stamps `_last_poll` up front to claim the
+        interval, so racing scrapes return False and serve the cached data
+        instead of queueing behind the sweep. Staleness reads the injected
+        clock, so ManualClock tests drive re-polls with no sleeps."""
         with self._poll_lock:
             with self._data_lock:
                 last = self._last_poll
-            if last is not None and monotonic_s() - last < self.interval_s:
-                return False
-            self.poll_once()
-            return True
+                if last is not None \
+                        and monotonic_s() - last < self.interval_s:
+                    return False
+                self._last_poll = monotonic_s()   # claim before the sweep
+        self.poll_once()
+        return True
 
     def _snapshot(self):
         with self._data_lock:

@@ -77,8 +77,10 @@ class LogBuffer:
         return out
 
     def to_dict(self, level=None, n=None, trace_id=None):
-        return {"records": self.records(level=level, n=n, trace_id=trace_id),
-                "count": self.total, "dropped": self.dropped,
+        records = self.records(level=level, n=n, trace_id=trace_id)
+        with self._lock:    # counters move with _items; snapshot under lock
+            total, dropped = self.total, self.dropped
+        return {"records": records, "count": total, "dropped": dropped,
                 "capacity": self.capacity}
 
     def clear(self):

@@ -250,6 +250,8 @@ class Tracer:
         flow-event pairs (ph "s"/"f") connecting the linked span's slice to
         the linking span's slice across lanes."""
         spans = self.finished_spans()
+        with self._lock:               # dropped moves with _finished
+            dropped = self.dropped
         lanes = {}                     # trace_id -> small int lane
         events = []
         by_span_id = {}
@@ -287,7 +289,7 @@ class Tracer:
                                "args": {"span_id": s.span_id}})
         return {"traceEvents": events, "displayTimeUnit": "ms",
                 "otherData": {"epoch_wall_s": self.epoch_wall,
-                              "dropped_spans": self.dropped}}
+                              "dropped_spans": dropped}}
 
     def export(self, path):
         """Write the Chrome-trace JSON to `path`; returns the path."""

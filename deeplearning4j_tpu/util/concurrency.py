@@ -206,3 +206,423 @@ class ConcurrentHashSet:
     def __len__(self):
         with self._lock:
             return len(self._set)
+
+
+# ---------------------------------------------------------------------------
+# lock sanitizer — the runtime half of the static GL018–GL020 rules
+# ---------------------------------------------------------------------------
+# The static pass (analysis/concurrency.py) proves what it can see; this is
+# the ThreadSanitizer-style dynamic check for what it can't: install() swaps
+# threading.Lock/RLock for a wrapping factory, so every lock created AFTER
+# the swap tracks per-thread held-sets, the pairwise acquisition-order graph
+# (an A->B edge plus a B->A edge observed at runtime = a real deadlock
+# candidate, reported once per pair), wait/hold timing into the telemetry
+# registry (lock_wait_ms / lock_hold_ms / lock_order_violations_total), and
+# an optional long-hold watchdog. Off (the default) it is ZERO overhead:
+# nothing is patched and locks are plain _thread primitives. The smoke arcs
+# run with it installed and assert zero violations; /debug/locks serves
+# table() live.
+
+import itertools
+import os as _os
+import sys as _sys
+
+#: the real factories, captured at import so SanitizedLock's inner locks and
+#: the sanitizer's own bookkeeping can never recurse into the wrapper
+_ORIG_LOCK = threading.Lock
+_ORIG_RLOCK = threading.RLock
+
+
+class SanitizedLock:
+    """Drop-in threading.Lock/RLock wrapper that reports to a LockSanitizer.
+
+    Supports the full lock protocol (context manager, acquire/release with
+    blocking/timeout, locked) plus the private Condition protocol
+    (_is_owned/_release_save/_acquire_restore), so Condition objects built
+    on a sanitized lock — including threading.Condition() defaults created
+    after install() — keep working, and their wait() cycles are tracked as
+    a full release + re-acquire."""
+
+    def __init__(self, sanitizer, reentrant, name, site):
+        self._san = sanitizer
+        self._reentrant = bool(reentrant)
+        self._inner = _ORIG_RLOCK() if reentrant else _ORIG_LOCK()
+        self.name = name
+        self.site = site         # creation file:line — the histogram label
+        self._owner = None       # thread ident; written only by the owner
+        self._count = 0          # recursion depth;   "      "     "
+        self._acquired_mono = None
+
+    # -- lock protocol -------------------------------------------------------
+    def acquire(self, blocking=True, timeout=-1):
+        san = self._san
+        if not san.tracking():
+            return self._inner.acquire(blocking, timeout)
+        me = threading.get_ident()
+        if self._reentrant and self._owner == me:
+            ok = self._inner.acquire(blocking, timeout)
+            if ok:
+                self._count += 1       # re-entry: no wait, no new edges
+            return ok
+        t0 = monotonic_s()
+        ok = self._inner.acquire(blocking, timeout)
+        if not ok:
+            return ok
+        self._owner, self._count = me, 1
+        self._acquired_mono = monotonic_s()
+        san._acquired(self, self._acquired_mono - t0)
+        return ok
+
+    def release(self):
+        san = self._san
+        if not san.tracking() or self._owner != threading.get_ident():
+            # untracked, or acquired while tracking was off/busy
+            self._inner.release()
+            return
+        self._count -= 1
+        if self._count == 0:
+            self._owner = None
+            t = self._acquired_mono
+            self._acquired_mono = None
+            san._released(self, None if t is None else monotonic_s() - t)
+        self._inner.release()
+
+    def locked(self):
+        return self._inner.locked()
+
+    def __enter__(self):
+        self.acquire()
+        return self
+
+    def __exit__(self, *exc):
+        self.release()
+        return False
+
+    def __repr__(self):
+        return f"<SanitizedLock {self.name} " \
+               f"{'RLock' if self._reentrant else 'Lock'}>"
+
+    # -- Condition protocol (threading.Condition probes for these) ----------
+    def _is_owned(self):
+        if self._reentrant:
+            return self._inner._is_owned()
+        # stdlib fallback semantics for plain Locks: "owned" = "held by
+        # anyone"; mirrored so Condition behaves identically either way
+        if self._inner.acquire(False):
+            self._inner.release()
+            return False
+        return True
+
+    def _release_save(self):
+        """Full release for Condition.wait — unwind tracking completely."""
+        if self._san.tracking() and self._owner == threading.get_ident():
+            self._owner, self._count = None, 0
+            t = self._acquired_mono
+            self._acquired_mono = None
+            self._san._released(self,
+                                None if t is None else monotonic_s() - t)
+        if self._reentrant:
+            return self._inner._release_save()
+        self._inner.release()
+        return None
+
+    def _acquire_restore(self, state):
+        t0 = monotonic_s()
+        if self._reentrant:
+            self._inner._acquire_restore(state)
+        else:
+            self._inner.acquire()
+        if self._san.tracking():
+            self._owner, self._count = threading.get_ident(), 1
+            self._acquired_mono = monotonic_s()
+            self._san._acquired(self, self._acquired_mono - t0)
+
+
+class LockSanitizer:
+    """Process-wide lock monitor. install() patches the threading module's
+    Lock/RLock factories; uninstall() restores them (already-created
+    sanitized locks fall back to passthrough). All bookkeeping runs on the
+    ORIGINAL primitives and behind a thread-local busy flag, so the
+    sanitizer can never deadlock against the instrument locks it reports
+    into. Timing reads util.time_source.monotonic_s, so ManualClock tests
+    drive hold/wait measurements with zero real sleeps."""
+
+    ENV_FLAG = "GRAFT_LOCK_SANITIZER"
+    ENV_LONG_HOLD = "GRAFT_LOCK_SANITIZER_LONG_HOLD_MS"
+
+    def __init__(self):
+        self._meta = _ORIG_LOCK()    # guards everything below; NO metric
+                                     # calls while held (creating a metric
+                                     # creates a Lock -> our own factory)
+        self._installed = False
+        self._enabled = False
+        self.long_hold_ms = None
+        self._seq = itertools.count(1)   # lock-free under the GIL
+        self._created = 0
+        self._edges = {}             # (id_a, id_b) -> {"from","to","count"}
+        self._held = {}              # thread ident -> [SanitizedLock, ...]
+        self._thread_names = {}      # thread ident -> thread name
+        self.violations = []         # dicts; bounded below
+        self._reported_pairs = set() # unordered id pairs already reported
+        self._reported_holds = set() # lock names already long-hold-reported
+        self._tls = threading.local()
+        self.max_violations = 256
+
+    # -- lifecycle -----------------------------------------------------------
+    def install(self, long_hold_ms=None):
+        """Patch threading.Lock/RLock. Idempotent; returns self."""
+        with self._meta:
+            self.long_hold_ms = None if long_hold_ms is None \
+                else float(long_hold_ms)
+            if self._installed:
+                self._enabled = True
+                return self
+            self._installed = True
+            self._enabled = True
+        threading.Lock = self._make_lock
+        threading.RLock = self._make_rlock
+        return self
+
+    def uninstall(self):
+        """Restore the real factories and stop tracking (stats are kept
+        until reset())."""
+        with self._meta:
+            if not self._installed:
+                return self
+            self._installed = False
+            self._enabled = False
+        threading.Lock = _ORIG_LOCK
+        threading.RLock = _ORIG_RLOCK
+        return self
+
+    def install_from_env(self, environ=None):
+        """install() iff $GRAFT_LOCK_SANITIZER is truthy — the seam the
+        smoke arcs and servers call unconditionally; a no-op (zero patching,
+        zero overhead) unless the operator opted in."""
+        env = _os.environ if environ is None else environ
+        if str(env.get(self.ENV_FLAG, "")).lower() not in ("1", "true",
+                                                           "yes", "on"):
+            return None
+        hold = env.get(self.ENV_LONG_HOLD)
+        return self.install(
+            long_hold_ms=float(hold) if hold else self.long_hold_ms)
+
+    def reset(self):
+        """Clear accumulated edges/violations (for tests and re-arming)."""
+        with self._meta:
+            self._edges.clear()
+            self._held.clear()
+            self._thread_names.clear()
+            self.violations = []
+            self._reported_pairs.clear()
+            self._reported_holds.clear()
+
+    @property
+    def installed(self):
+        return self._installed
+
+    def tracking(self):
+        """True when acquire/release events should be recorded: enabled and
+        not re-entering from the sanitizer's own reporting path."""
+        return self._enabled and not getattr(self._tls, "busy", False)
+
+    # -- factories (what threading.Lock/RLock become) ------------------------
+    def _make_lock(self):
+        if getattr(self._tls, "busy", False):
+            return _ORIG_LOCK()      # locks born inside the reporting path
+        return SanitizedLock(self, False, *self._site_name("Lock"))
+
+    def _make_rlock(self):
+        if getattr(self._tls, "busy", False):
+            return _ORIG_RLOCK()     # (telemetry internals) stay plain
+        return SanitizedLock(self, True, *self._site_name("RLock"))
+
+    def _site_name(self, kind):
+        # NOT under _meta: the factory runs from arbitrary code, including
+        # metric construction triggered by our own reporting while _meta is
+        # held — itertools.count is atomic enough for a display name
+        n = next(self._seq)
+        self._created = n
+        try:
+            f = _sys._getframe(2)    # _make_* <- threading.Lock() <- caller
+            site = f"{_os.path.basename(f.f_code.co_filename)}:{f.f_lineno}"
+        except Exception:
+            site = "?"
+        return f"{kind}#{n}({site})", site
+
+    # -- event sinks (called by SanitizedLock) -------------------------------
+    @staticmethod
+    def _thread_name(ident):
+        # side-effect-free name lookup: threading.current_thread() REGISTERS
+        # a _DummyThread for unknown threads, and that registration acquires
+        # an Event lock — which, sanitized, would re-enter this very path
+        t = threading._active.get(ident)
+        return t.name if t is not None else f"thread-{ident}"
+
+    def _acquired(self, lock, waited_s):
+        me = threading.get_ident()
+        inversions = 0
+        with self._meta:
+            held = self._held.setdefault(me, [])
+            self._thread_names[me] = self._thread_name(me)
+            for prior in held:
+                if prior is lock:
+                    continue
+                inversions += self._edge(prior, lock, me)
+            held.append(lock)
+        for _ in range(inversions):      # metric calls OUTSIDE _meta
+            self._count_inc("lock_order_violations_total")
+        self._observe("lock_wait_ms", waited_s * 1000.0, lock)
+
+    def _released(self, lock, held_s):
+        me = threading.get_ident()
+        with self._meta:
+            held = self._held.get(me)
+            if held is not None and lock in held:
+                held.remove(lock)
+                if not held:
+                    del self._held[me]
+        if held_s is None:
+            return
+        held_ms = held_s * 1000.0
+        self._observe("lock_hold_ms", held_ms, lock)
+        if self.long_hold_ms is not None and held_ms > self.long_hold_ms:
+            with self._meta:
+                if lock.name in self._reported_holds:
+                    return
+                self._reported_holds.add(lock.name)
+                self._record({
+                    "kind": "long-hold", "lock": lock.name,
+                    "held_ms": round(held_ms, 3),
+                    "limit_ms": self.long_hold_ms,
+                    "thread": self._thread_name(me),
+                })
+
+    def _edge(self, a, b, ident):
+        """Record a->b (a held while acquiring b); a pre-existing b->a edge
+        is a lock-order inversion, reported once per unordered pair. Caller
+        holds _meta; returns 1 when a NEW inversion was recorded so the
+        caller can bump the counter after releasing it."""
+        key = (id(a), id(b))
+        e = self._edges.get(key)
+        if e is None:
+            self._edges[key] = {"from": a.name, "to": b.name, "count": 1}
+        else:
+            e["count"] += 1
+        rev = self._edges.get((id(b), id(a)))
+        if rev is None:
+            return 0
+        pair = frozenset((id(a), id(b)))
+        if pair in self._reported_pairs:
+            return 0
+        self._reported_pairs.add(pair)
+        self._record({
+            "kind": "lock-order-inversion",
+            "locks": [a.name, b.name],
+            "thread": self._thread_names.get(ident, str(ident)),
+            "detail": f"{a.name} -> {b.name} observed while "
+                      f"{b.name} -> {a.name} was already on record",
+        })
+        return 1
+
+    def _record(self, violation):
+        # caller holds self._meta
+        if len(self.violations) < self.max_violations:
+            self.violations.append(violation)
+
+    # -- metrics (lazy; never allowed to deadlock or raise) ------------------
+    # The instruments are fetched ONCE (under the busy flag, so any locks
+    # telemetry creates along the way come out plain), and every lock that
+    # belongs to the telemetry plumbing itself is excluded from reporting:
+    # observing the wait time of the wait-histogram's own lock into that
+    # same histogram would re-acquire a lock the calling thread already
+    # holds.
+    def _instruments(self):
+        m = self.__dict__.get("_m")
+        if m is not None:
+            return m
+        self._tls.busy = True
+        try:
+            from ..telemetry.registry import get_registry
+            reg = get_registry()
+            m = {
+                "lock_wait_ms": reg.histogram(
+                    "lock_wait_ms", "time spent blocked acquiring locks"),
+                "lock_hold_ms": reg.histogram(
+                    "lock_hold_ms", "time locks were held"),
+                "lock_order_violations_total": reg.counter(
+                    "lock_order_violations_total",
+                    "runtime lock-order inversions detected"),
+            }
+            skip = {id(reg._lock)}
+            skip.update(id(inst._lock) for inst in m.values())
+            self._metric_lock_ids = skip
+            self._m = m
+        except Exception:
+            self._m = m = {}
+            self._metric_lock_ids = set()
+        finally:
+            self._tls.busy = False
+        return m
+
+    def _observe(self, name, value_ms, lock):
+        if getattr(self._tls, "busy", False):
+            return
+        m = self._instruments()
+        hist = m.get(name)
+        if hist is None or id(lock) in self._metric_lock_ids:
+            return
+        self._tls.busy = True
+        try:
+            hist.observe(value_ms, lock=lock.site)
+        except Exception:
+            pass                     # telemetry must never break a lock
+        finally:
+            self._tls.busy = False
+
+    def _count_inc(self, name):
+        if getattr(self._tls, "busy", False):
+            return
+        ctr = self._instruments().get(name)
+        if ctr is None:
+            return
+        self._tls.busy = True
+        try:
+            ctr.inc()
+        except Exception:
+            pass
+        finally:
+            self._tls.busy = False
+
+    # -- exposition ----------------------------------------------------------
+    def table(self):
+        """JSON-friendly live state for GET /debug/locks."""
+        with self._meta:
+            return {
+                "installed": self._installed,
+                "long_hold_ms": self.long_hold_ms,
+                "locks_created": self._created,
+                "violations": [dict(v) for v in self.violations],
+                "edges": sorted((dict(e) for e in self._edges.values()),
+                                key=lambda e: (e["from"], e["to"])),
+                "held": {
+                    self._thread_names.get(ident, str(ident)):
+                        [lk.name for lk in locks]
+                    for ident, locks in self._held.items()},
+            }
+
+    def report(self):
+        """Summary for smoke-arc assertions: violation count + kinds."""
+        with self._meta:
+            kinds = {}
+            for v in self.violations:
+                kinds[v["kind"]] = kinds.get(v["kind"], 0) + 1
+            return {"installed": self._installed,
+                    "violations": len(self.violations),
+                    "by_kind": kinds,
+                    "edges": len(self._edges)}
+
+
+#: process singleton — servers expose table() at /debug/locks, smoke arcs
+#: install()/report()/uninstall() around their scenario
+lock_sanitizer = LockSanitizer()

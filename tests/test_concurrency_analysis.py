@@ -1,0 +1,613 @@
+"""Concurrency analysis tests: the GL018/GL019/GL020 whole-program rules
+(lockset inference edges: with-vs-acquire/release, RLock re-entry, locks
+passed to helpers, callback references, external locks), the shared GL003
+annotation channel, the runtime lock sanitizer (ManualClock-driven — zero
+real sleeps), the --baseline-prune CLI, and the repo-wide gate: the whole
+package + tools/ must produce ZERO new concurrency findings inside a 5s
+wall-time budget."""
+import json
+import os
+import subprocess
+import sys
+import textwrap
+import threading
+import time
+from pathlib import Path
+
+import pytest
+
+from deeplearning4j_tpu.analysis import Analyzer, Baseline, get_rule
+
+REPO = Path(__file__).resolve().parents[1]
+BASELINE_PATH = REPO / "tools" / "lint_baseline.json"
+
+CONCURRENCY_RULES = ("GL018", "GL019", "GL020")
+
+
+def lint(src, rules, rel_path="deeplearning4j_tpu/pkg/mod.py"):
+    analyzer = Analyzer(rules=[get_rule(r) for r in rules], root=str(REPO))
+    violations, err = analyzer.analyze_source(textwrap.dedent(src), rel_path)
+    assert err is None, err
+    return violations
+
+
+# ---------------------------------------------------------------------------
+# GL018 — unguarded-shared-write
+# ---------------------------------------------------------------------------
+
+def test_gl018_locked_write_then_lockfree_read():
+    violations = lint("""\
+    import threading
+
+    class Stats:
+        def __init__(self):
+            self._lock = threading.Lock()
+            self.total = 0
+
+        def add(self, n):
+            with self._lock:
+                self.total += n
+
+        def snapshot(self):
+            return {"total": self.total}
+    """, rules=["GL018"])
+    assert [(v.rule, v.line) for v in violations] == [("GL018", 13)]
+    assert "self.total is written under self._lock in add()" \
+        in violations[0].message
+    assert "guarded by: none" in violations[0].message   # actionable fix
+
+
+def test_gl018_guarded_by_none_declares_intent():
+    # `# guarded by: none` is the explicit copy-on-write/monotonic-read
+    # channel: the writer stays serialized, readers are declared lock-free
+    violations = lint("""\
+    import threading
+
+    class Pool:
+        def __init__(self):
+            self._lock = threading.Lock()
+            self.items = []   # guarded by: none
+
+        def add(self, x):
+            with self._lock:
+                self.items = self.items + [x]
+
+        def read(self):
+            return list(self.items)
+    """, rules=["GL018"])
+    assert violations == []
+
+
+def test_gl018_annotation_on_multiline_declaration():
+    # the annotation may sit on ANY line of a multi-line declaration
+    # (closing bracket included), not just the statement's first line
+    violations = lint("""\
+    import threading
+
+    class Pool:
+        def __init__(self):
+            self._lock = threading.Lock()
+            self.items = [
+                0,
+            ]   # guarded by: none
+
+        def add(self, x):
+            with self._lock:
+                self.items = self.items + [x]
+
+        def read(self):
+            return list(self.items)
+    """, rules=["GL018"])
+    assert violations == []
+
+
+def test_gl018_guarded_by_lock_routes_to_gl003():
+    # an explicit `# guarded by: self._lock` moves the attribute to GL003's
+    # annotation channel — GL018 must not double-report it
+    src = """\
+    import threading
+
+    class Stats:
+        def __init__(self):
+            self.total = 0   # guarded by: self._lock
+            self._lock = threading.Lock()
+
+        def add(self, n):
+            with self._lock:
+                self.total += n
+
+        def snapshot(self):
+            return {"total": self.total}
+    """
+    assert lint(src, rules=["GL018"]) == []
+    gl003 = lint(src, rules=["GL003"])
+    assert [(v.rule, v.line) for v in gl003] == [("GL003", 13)]
+
+
+def test_gl018_lock_passed_to_helper_binds_param():
+    # self._helper(self._lock) + `with lock:` in the helper resolves the
+    # parameter to the lock attribute, so the helper's write counts as
+    # locked and the lock-free reader is the one flagged
+    violations = lint("""\
+    import threading
+
+    class Owner:
+        def __init__(self):
+            self._lock = threading.Lock()
+            self.n = 0
+
+        def bump(self):
+            self._helper(self._lock)
+
+        def _helper(self, lock):
+            with lock:
+                self.n += 1
+
+        def read(self):
+            return self.n
+    """, rules=["GL018"])
+    assert [(v.rule, v.line) for v in violations] == [("GL018", 16)]
+
+
+def test_gl018_callback_reference_counts_as_locked_call_site():
+    # `self._retry.call(self._attempt, obj)` under the lock: the bare
+    # method reference makes _attempt's accesses inherit the caller's
+    # lockset (the streaming-broker retry idiom) — no false positive
+    violations = lint("""\
+    import threading
+
+    class Retry:
+        def call(self, fn, obj):
+            return fn(obj)
+
+    class Client:
+        def __init__(self):
+            self._lock = threading.Lock()
+            self._retry = Retry()
+            self._sock = None
+
+        def request(self, obj):
+            with self._lock:
+                return self._retry.call(self._attempt, obj)
+
+        def close(self):
+            with self._lock:
+                self._sock = None
+
+        def _attempt(self, obj):
+            self._sock = obj
+            return self._sock
+    """, rules=["GL018"])
+    assert violations == []
+
+
+def test_gl018_acquire_release_form_counts_as_locked():
+    # lockset tracking follows acquire()/release() (try/finally form) the
+    # same as `with` blocks
+    violations = lint("""\
+    import threading
+
+    class Stats:
+        def __init__(self):
+            self._lock = threading.Lock()
+            self.total = 0
+
+        def add(self, n):
+            self._lock.acquire()
+            try:
+                self.total += n
+            finally:
+                self._lock.release()
+
+        def snapshot(self):
+            return self.total
+    """, rules=["GL018"])
+    assert [(v.rule, v.line) for v in violations] == [("GL018", 16)]
+
+
+# ---------------------------------------------------------------------------
+# GL019 — blocking-under-lock
+# ---------------------------------------------------------------------------
+
+def test_gl019_sleep_under_with():
+    violations = lint("""\
+    import threading
+    import time
+
+    class Poller:
+        def __init__(self):
+            self._lock = threading.Lock()
+
+        def tick(self):
+            with self._lock:
+                time.sleep(1.0)
+    """, rules=["GL019"])
+    assert [(v.rule, v.line) for v in violations] == [("GL019", 10)]
+    assert "time.sleep() blocks while holding self._lock" \
+        in violations[0].message
+
+
+def test_gl019_blocking_reached_through_helper():
+    # acquire/try/finally in the caller, sleep in a private helper: flagged
+    # once, at the lock-holding call site (propagation through the call
+    # graph), not inside the helper — the helper is innocent on its own
+    violations = lint("""\
+    import threading
+    import time
+
+    class Poller:
+        def __init__(self):
+            self._lock = threading.Lock()
+
+        def tick(self):
+            self._lock.acquire()
+            try:
+                self._sweep()
+            finally:
+                self._lock.release()
+
+        def _sweep(self):
+            time.sleep(0.5)
+    """, rules=["GL019"])
+    assert [(v.rule, v.line) for v in violations] == [("GL019", 11)]
+    assert "self._sweep() reaches blocking time.sleep() while holding " \
+        "self._lock" in violations[0].message
+
+
+def test_gl019_external_lock_attribute():
+    # `with ctx.run_lock:` — a lock-ish attribute of a local — is held
+    # state for blocking detection even though it is not a self-attribute
+    # (the mesh dispatch shape)
+    violations = lint("""\
+    import jax
+
+    class Dispatcher:
+        def run(self, ctx, out):
+            with ctx.run_lock:
+                jax.block_until_ready(out)
+    """, rules=["GL019"])
+    assert [(v.rule, v.line) for v in violations] == [("GL019", 6)]
+    assert "ctx.run_lock" in violations[0].message
+
+
+def test_gl019_condition_wait_is_exempt():
+    # Condition.wait releases the lock it waits on — NOT blocking-under-lock
+    violations = lint("""\
+    import threading
+
+    class Q:
+        def __init__(self):
+            self._work = threading.Condition()
+            self._items = []
+
+        def take(self):
+            with self._work:
+                while not self._items:
+                    self._work.wait()
+                return self._items.pop()
+    """, rules=["GL019"])
+    assert violations == []
+
+
+# ---------------------------------------------------------------------------
+# GL020 — lock-order-inversion
+# ---------------------------------------------------------------------------
+
+def test_gl020_two_lock_cycle_reports_both_paths():
+    violations = lint("""\
+    import threading
+
+    class Pair:
+        def __init__(self):
+            self._a = threading.Lock()
+            self._b = threading.Lock()
+
+        def fwd(self):
+            with self._a:
+                with self._b:
+                    pass
+
+        def rev(self):
+            with self._b:
+                with self._a:
+                    pass
+    """, rules=["GL020"])
+    assert len(violations) == 2, violations
+    assert sorted(v.line for v in violations) == [10, 15]
+    # each edge report cites the counter-path closing the cycle
+    for v in violations:
+        assert "closes the cycle" in v.message
+
+
+def test_gl020_plain_lock_reacquire_is_self_deadlock():
+    violations = lint("""\
+    import threading
+
+    class Re:
+        def __init__(self):
+            self._lock = threading.Lock()
+
+        def outer(self):
+            with self._lock:
+                self._inner()
+
+        def _inner(self):
+            with self._lock:
+                pass
+    """, rules=["GL020"])
+    assert violations, "non-reentrant re-acquire must be flagged"
+    assert any("re-acquires non-reentrant" in v.message or
+               "closes the cycle" in v.message for v in violations)
+
+
+def test_gl020_rlock_reentry_is_quiet():
+    violations = lint("""\
+    import threading
+
+    class Re:
+        def __init__(self):
+            self._lock = threading.RLock()
+
+        def outer(self):
+            with self._lock:
+                self._inner()
+
+        def _inner(self):
+            with self._lock:
+                pass
+    """, rules=["GL020"])
+    assert violations == []
+
+
+def test_gl020_consistent_order_is_quiet():
+    violations = lint("""\
+    import threading
+
+    class Pair:
+        def __init__(self):
+            self._a = threading.Lock()
+            self._b = threading.Lock()
+
+        def one(self):
+            with self._a:
+                with self._b:
+                    pass
+
+        def two(self):
+            with self._a:
+                with self._b:
+                    pass
+    """, rules=["GL020"])
+    assert violations == []
+
+
+# ---------------------------------------------------------------------------
+# runtime lock sanitizer (ManualClock-driven: zero real sleeps)
+# ---------------------------------------------------------------------------
+
+@pytest.fixture
+def sanitizer():
+    from deeplearning4j_tpu.util.concurrency import lock_sanitizer
+    from deeplearning4j_tpu.util.time_source import (ManualClock,
+                                                     TimeSourceProvider)
+    clock = ManualClock(start_s=100.0)
+    TimeSourceProvider.set_instance(clock)
+    lock_sanitizer.reset()
+    try:
+        yield lock_sanitizer, clock
+    finally:
+        lock_sanitizer.uninstall()
+        lock_sanitizer.reset()
+        TimeSourceProvider.reset()
+
+
+def test_sanitizer_inversion_fires_exactly_once(sanitizer):
+    san, _ = sanitizer
+    san.install()
+    a, b = threading.Lock(), threading.Lock()
+    assert type(a).__name__ == "SanitizedLock"
+    with a:
+        with b:
+            pass
+    for _ in range(3):          # opposite order, repeatedly
+        with b:
+            with a:
+                pass
+    rep = san.report()
+    assert rep["by_kind"] == {"lock-order-inversion": 1}, rep
+    v = san.table()["violations"][0]
+    assert v["kind"] == "lock-order-inversion"
+    assert set(v["locks"]) == {a.name, b.name}
+
+
+def test_sanitizer_long_hold_fires_exactly_once_per_lock(sanitizer):
+    san, clock = sanitizer
+    san.install(long_hold_ms=50)
+    lk = threading.Lock()
+    for _ in range(2):
+        lk.acquire()
+        clock.advance(0.2)      # 200ms hold measured off the ManualClock
+        lk.release()
+    rep = san.report()
+    assert rep["by_kind"] == {"long-hold": 1}, rep
+    v = san.table()["violations"][0]
+    assert v["held_ms"] == pytest.approx(200.0)
+    assert v["limit_ms"] == 50.0
+
+
+def test_sanitizer_rlock_reentry_and_consistent_order_are_clean(sanitizer):
+    san, _ = sanitizer
+    san.install()
+    r = threading.RLock()
+    with r:
+        with r:
+            pass
+    a, b = threading.Lock(), threading.Lock()
+    for _ in range(2):
+        with a:
+            with b:
+                pass
+    assert san.report()["violations"] == 0
+
+
+def test_sanitizer_condition_protocol_round_trip(sanitizer):
+    # Condition() built after install wraps a sanitized RLock; wait(0)
+    # exercises _release_save/_acquire_restore with no second thread
+    san, _ = sanitizer
+    san.install()
+    cv = threading.Condition()
+    with cv:
+        cv.wait(timeout=0)
+    assert san.report()["violations"] == 0
+    assert san.table()["held"] == {}
+
+
+def test_sanitizer_uninstall_restores_plain_locks(sanitizer):
+    san, _ = sanitizer
+    orig = type(threading.Lock())
+    san.install()
+    assert type(threading.Lock()).__name__ == "SanitizedLock"
+    san.uninstall()
+    assert type(threading.Lock()) is orig
+
+
+def test_sanitizer_env_gate(sanitizer):
+    san, _ = sanitizer
+    assert san.install_from_env(environ={}) is None
+    assert not san.installed
+    assert san.install_from_env(
+        environ={"GRAFT_LOCK_SANITIZER": "1",
+                 "GRAFT_LOCK_SANITIZER_LONG_HOLD_MS": "75"}) is san
+    assert san.installed and san.long_hold_ms == 75.0
+
+
+def test_sanitizer_table_is_json_serializable(sanitizer):
+    san, clock = sanitizer
+    san.install(long_hold_ms=10)
+    a, b = threading.Lock(), threading.Lock()
+    with a:
+        clock.advance(0.05)
+        with b:
+            pass
+    tbl = json.loads(json.dumps(san.table()))
+    assert tbl["installed"] is True
+    assert tbl["violations"] and tbl["edges"]
+    assert tbl["locks_created"] >= 2
+
+
+# ---------------------------------------------------------------------------
+# CLI: --baseline-prune
+# ---------------------------------------------------------------------------
+
+BAD_CLASS = textwrap.dedent("""\
+import time
+import threading
+
+class C:
+    def __init__(self):
+        self._lock = threading.Lock()
+
+    def work(self):
+        with self._lock:
+            time.sleep(1)
+""")
+
+
+def _lint_cli(root, baseline, *extra):
+    return subprocess.run(
+        [sys.executable, "-m", "deeplearning4j_tpu.analysis", "pkg",
+         "--root", str(root), "--baseline", str(baseline), *extra],
+        cwd=str(root), capture_output=True, text=True,
+        env={**os.environ, "PYTHONPATH": str(REPO), "JAX_PLATFORMS": "cpu"})
+
+
+def test_baseline_prune_round_trip(tmp_path):
+    pkg = tmp_path / "pkg"
+    pkg.mkdir()
+    mod = pkg / "mod.py"
+    baseline = tmp_path / "baseline.json"
+    mod.write_text(BAD_CLASS)
+
+    # seed the baseline from the violation, then FIX the code
+    assert _lint_cli(tmp_path, baseline, "--baseline-update").returncode == 0
+    entries = json.loads(baseline.read_text())["entries"]
+    assert [e["rule"] for e in entries] == ["GL019"]
+    mod.write_text(BAD_CLASS.replace(
+        "            time.sleep(1)\n",
+        "            pass\n        time.sleep(1)\n"))
+
+    proc = _lint_cli(tmp_path, baseline, "--baseline-prune")
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    assert "1 stale entry removed" in proc.stdout
+    assert json.loads(baseline.read_text())["entries"] == []
+    # and the post-prune lint is clean (round trip)
+    assert _lint_cli(tmp_path, baseline).returncode == 0
+
+
+def test_baseline_prune_is_scoped_to_active_rules(tmp_path):
+    pkg = tmp_path / "pkg"
+    pkg.mkdir()
+    mod = pkg / "mod.py"
+    baseline = tmp_path / "baseline.json"
+    mod.write_text(BAD_CLASS)
+    assert _lint_cli(tmp_path, baseline, "--baseline-update").returncode == 0
+    mod.write_text("x = 1\n")          # the GL019 finding is gone
+
+    # prune with a DIFFERENT rule active: the GL019 entry is out of scope
+    # and must be preserved verbatim
+    proc = _lint_cli(tmp_path, baseline, "--baseline-prune",
+                     "--rules", "GL018")
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    assert [e["rule"] for e in json.loads(baseline.read_text())["entries"]] \
+        == ["GL019"]
+
+    # in-scope prune drops it
+    proc = _lint_cli(tmp_path, baseline, "--baseline-prune")
+    assert proc.returncode == 0
+    assert json.loads(baseline.read_text())["entries"] == []
+
+
+def test_baseline_prune_refuses_on_parse_errors(tmp_path):
+    pkg = tmp_path / "pkg"
+    pkg.mkdir()
+    (pkg / "mod.py").write_text(BAD_CLASS)
+    baseline = tmp_path / "baseline.json"
+    assert _lint_cli(tmp_path, baseline, "--baseline-update").returncode == 0
+    (pkg / "mod.py").write_text("def broken(:\n")
+    proc = _lint_cli(tmp_path, baseline, "--baseline-prune")
+    assert proc.returncode == 1
+    assert "NOT pruned" in proc.stdout
+    assert [e["rule"] for e in json.loads(baseline.read_text())["entries"]] \
+        == ["GL019"]
+
+
+def test_baseline_update_and_prune_are_mutually_exclusive(tmp_path):
+    pkg = tmp_path / "pkg"
+    pkg.mkdir()
+    (pkg / "mod.py").write_text("x = 1\n")
+    proc = _lint_cli(tmp_path, tmp_path / "b.json",
+                     "--baseline-update", "--baseline-prune")
+    assert proc.returncode == 2
+
+
+# ---------------------------------------------------------------------------
+# repo-wide gate + budget
+# ---------------------------------------------------------------------------
+
+def test_repo_concurrency_pass_is_clean_and_fast():
+    """The gate: GL018/GL019/GL020 over the whole package + tools/ produce
+    zero NEW findings (intentional remainders live in the committed,
+    note-complete baseline) inside a 5s wall-time budget."""
+    rules = [get_rule(r) for r in CONCURRENCY_RULES]
+    t0 = time.monotonic()
+    report = Analyzer(rules=rules, root=str(REPO)).analyze_paths(
+        ["deeplearning4j_tpu", "tools"])
+    wall = time.monotonic() - t0
+    assert not report.errors, report.errors
+    new, matched = Baseline.load(str(BASELINE_PATH)).split(report.violations)
+    assert new == [], [str(v) for v in new]
+    # every baselined concurrency finding carries an explanatory note
+    noted = [e for e in Baseline.load(str(BASELINE_PATH)).entries
+             if e["rule"] in CONCURRENCY_RULES]
+    assert noted and all(e["note"].strip() for e in noted)
+    assert wall < 5.0, f"concurrency pass took {wall:.2f}s (budget 5s)"

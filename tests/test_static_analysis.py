@@ -1258,7 +1258,7 @@ def test_cli_rule_subset_and_list_rules():
     assert [r.id for r in all_rules()] == \
         ["GL001", "GL002", "GL003", "GL004", "GL005", "GL006", "GL007",
          "GL008", "GL009", "GL010", "GL011", "GL012", "GL013", "GL014",
-         "GL015", "GL016", "GL017"]
+         "GL015", "GL016", "GL017", "GL018", "GL019", "GL020"]
 
 
 def test_repo_gate_is_clean_and_fast():

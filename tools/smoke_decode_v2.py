@@ -233,17 +233,28 @@ def _spec_arc():
 
 
 def run(n_requests=8):
-    with warnings.catch_warnings(record=True) as caught:
-        warnings.simplefilter("always")
-        sampling = _sampling_arc(n_requests)
-        paged = _paged_arc(n_requests)
-        spec = _spec_arc()
+    # all three arcs run with the lock sanitizer live: the scheduler loop,
+    # paged KV pool, and speculative verify all juggle locks across threads
+    from deeplearning4j_tpu.util.concurrency import lock_sanitizer
+    lock_sanitizer.reset()
+    lock_sanitizer.install()
+    try:
+        with warnings.catch_warnings(record=True) as caught:
+            warnings.simplefilter("always")
+            sampling = _sampling_arc(n_requests)
+            paged = _paged_arc(n_requests)
+            spec = _spec_arc()
+    finally:
+        lock_report = lock_sanitizer.report()
+        lock_sanitizer.uninstall()
     donation = [w for w in caught
                 if "donated buffers were not usable" in str(w.message)]
     assert not donation, \
         [str(w.message).splitlines()[0] for w in donation]
+    assert lock_report["violations"] == 0, \
+        f"lock sanitizer: {lock_sanitizer.table()['violations']}"
     return {"sampling": sampling, "paged": paged, "speculative": spec,
-            "donation_warnings": 0}
+            "donation_warnings": 0, "lock_sanitizer": lock_report}
 
 
 def main():

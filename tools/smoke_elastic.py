@@ -66,6 +66,13 @@ def run(burst_rate=2000.0, burst_s=0.05, nin=6, seed=0, scan_dir=None):
     ModelSerializer.write_model(_tiny_net(nin=nin, seed=seed),
                                 str(Path(scan_dir) / "v1.zip"))
 
+    # sanitized locks for the whole elastic stack (frontend, launcher
+    # replicas, autoscaler, fleet poller) — the arc asserts zero runtime
+    # lock-order violations under burst load + preemption
+    from deeplearning4j_tpu.util.concurrency import lock_sanitizer
+    lock_sanitizer.reset()
+    lock_sanitizer.install()
+
     launcher = InProcessLauncher(
         scan_dir=str(scan_dir), max_replicas=POLICY["max_replicas"],
         server_opts=dict(max_batch_size=4, queue_capacity=2,
@@ -164,7 +171,10 @@ def run(burst_rate=2000.0, burst_s=0.05, nin=6, seed=0, scan_dir=None):
             "scale_logs_traced": all(rec.get("trace_id")
                                      for rec in scale_logs),
             "preemptions": plan.injected(),
+            "lock_sanitizer": lock_sanitizer.report(),
         }
+        assert out["lock_sanitizer"]["violations"] == 0, \
+            f"lock sanitizer: {lock_sanitizer.table()['violations']}"
         assert out["client_5xx"] == 0, out
         assert max(pool_sizes) == 3 and pool_sizes[-1] == 1, out
         assert up1 == "scale_up" and up2 == "scale_up", out
@@ -174,6 +184,7 @@ def run(burst_rate=2000.0, burst_s=0.05, nin=6, seed=0, scan_dir=None):
         assert out["scale_log_records"] >= 4 and out["scale_logs_traced"], out
         return out
     finally:
+        lock_sanitizer.uninstall()
         if fleet is not None:
             fleet.stop()
         if fe is not None:

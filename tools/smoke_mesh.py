@@ -75,38 +75,52 @@ def run(n_predict=12, n_generate=4, max_new_tokens=5, slots=3, max_len=64):
     pred_xs = [eye[rng.integers(0, VOCAB, L)][None].tolist()
                for L in pred_lens]    # one-hot [1, L, vocab] token rows
 
-    with warnings.catch_warnings(record=True) as caught:
-        warnings.simplefilter("always")
-        with tempfile.TemporaryDirectory() as tmp:
-            ModelSerializer.write_model(_model(), os.path.join(tmp, "lm.zip"),
-                                        save_updater=False)
-            ModelSerializer.write_model(_model(seed=8),
-                                        os.path.join(tmp, "lm2.zip"),
-                                        save_updater=False)
-            mesh_srv = ServingServer(scan_dir=tmp, decode=True,
-                                     decode_slots=slots,
-                                     decode_max_len=max_len,
-                                     max_batch_size=4,
-                                     mesh=mesh_spec).start()
-            ref_srv = ServingServer(scan_dir=tmp, decode=True,
-                                    decode_slots=slots,
-                                    decode_max_len=max_len,
-                                    max_batch_size=4).start()
-            fe = FleetFrontend([ref_srv.url, mesh_srv.url],
-                               names=["solo", "mesh"],
-                               health_interval_s=0.0).start()
-            try:
-                out = _drive(mesh_srv, ref_srv, fe, prompts, pred_xs,
-                             max_new_tokens, get_json, post_json, np)
-            finally:
-                fe.stop()
-                mesh_srv.stop()
-                ref_srv.stop()
+    # both planes (mesh + solo) and the frontend run on sanitized locks;
+    # the mesh run_lock serializing one wave per mesh (PR 16) is exactly
+    # the kind of lock whose ordering this arc now checks at runtime
+    from deeplearning4j_tpu.util.concurrency import lock_sanitizer
+    lock_sanitizer.reset()
+    lock_sanitizer.install()
+    try:
+        with warnings.catch_warnings(record=True) as caught:
+            warnings.simplefilter("always")
+            with tempfile.TemporaryDirectory() as tmp:
+                ModelSerializer.write_model(_model(),
+                                            os.path.join(tmp, "lm.zip"),
+                                            save_updater=False)
+                ModelSerializer.write_model(_model(seed=8),
+                                            os.path.join(tmp, "lm2.zip"),
+                                            save_updater=False)
+                mesh_srv = ServingServer(scan_dir=tmp, decode=True,
+                                         decode_slots=slots,
+                                         decode_max_len=max_len,
+                                         max_batch_size=4,
+                                         mesh=mesh_spec).start()
+                ref_srv = ServingServer(scan_dir=tmp, decode=True,
+                                        decode_slots=slots,
+                                        decode_max_len=max_len,
+                                        max_batch_size=4).start()
+                fe = FleetFrontend([ref_srv.url, mesh_srv.url],
+                                   names=["solo", "mesh"],
+                                   health_interval_s=0.0).start()
+                try:
+                    out = _drive(mesh_srv, ref_srv, fe, prompts, pred_xs,
+                                 max_new_tokens, get_json, post_json, np)
+                finally:
+                    fe.stop()
+                    mesh_srv.stop()
+                    ref_srv.stop()
+    finally:
+        lock_report = lock_sanitizer.report()
+        lock_sanitizer.uninstall()
     donation = [w for w in caught
                 if "donated buffers were not usable" in str(w.message)]
     out["donation_warnings"] = len(donation)
     assert out["donation_warnings"] == 0, \
         [str(w.message).splitlines()[0] for w in donation]
+    out["lock_sanitizer"] = lock_report
+    assert lock_report["violations"] == 0, \
+        f"lock sanitizer: {lock_sanitizer.table()['violations']}"
     return out
 
 

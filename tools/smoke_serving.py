@@ -38,48 +38,58 @@ def run(n_requests=200, concurrency=16, max_rows=4, p99_budget_ms=10000.0,
     from deeplearning4j_tpu.zoo.models import mlp_mnist
 
     model = mlp_mnist(hidden=hidden)
-    server = ServingServer(model, max_batch_size=16, max_latency_ms=5.0,
-                           queue_capacity=max(64, n_requests)).start()
-    rng = np.random.default_rng(seed)
-    # one request per worker up front so every bucket compiles before timing
-    for rows in range(1, max_rows + 1):
-        server.predict(rng.normal(size=(rows, 784)).astype(np.float32))
+    # every lock the serving stack creates below runs sanitized: the arc
+    # fails if concurrent load reveals a lock-order inversion at runtime
+    from deeplearning4j_tpu.util.concurrency import lock_sanitizer
+    lock_sanitizer.reset()
+    lock_sanitizer.install()
+    try:
+        server = ServingServer(model, max_batch_size=16, max_latency_ms=5.0,
+                               queue_capacity=max(64, n_requests)).start()
+        rng = np.random.default_rng(seed)
+        # one request per worker up front so every bucket compiles before
+        # timing
+        for rows in range(1, max_rows + 1):
+            server.predict(rng.normal(size=(rows, 784)).astype(np.float32))
 
-    bodies = []
-    for _ in range(n_requests):
-        rows = int(rng.integers(1, max_rows + 1))
-        x = rng.normal(size=(rows, 784)).astype(np.float32)
-        bodies.append((rows, json.dumps({"data": x.tolist()}).encode()))
+        bodies = []
+        for _ in range(n_requests):
+            rows = int(rng.integers(1, max_rows + 1))
+            x = rng.normal(size=(rows, 784)).astype(np.float32)
+            bodies.append((rows, json.dumps({"data": x.tolist()}).encode()))
 
-    def fire(body):
-        rows, payload = body
-        t0 = time.monotonic()
-        req = urllib.request.Request(
-            server.url + "/predict", data=payload,
-            headers={"Content-Type": "application/json"})
-        with urllib.request.urlopen(req, timeout=60) as r:
-            out = json.loads(r.read())
-        ms = (time.monotonic() - t0) * 1000.0
-        assert len(out["prediction"]) == rows, out["shape"]
-        return ms
+        def fire(body):
+            rows, payload = body
+            t0 = time.monotonic()
+            req = urllib.request.Request(
+                server.url + "/predict", data=payload,
+                headers={"Content-Type": "application/json"})
+            with urllib.request.urlopen(req, timeout=60) as r:
+                out = json.loads(r.read())
+            ms = (time.monotonic() - t0) * 1000.0
+            assert len(out["prediction"]) == rows, out["shape"]
+            return ms
 
-    t_start = time.monotonic()
-    errors = []
-    latencies = []
-    with ThreadPoolExecutor(max_workers=concurrency) as pool:
-        for fut in [pool.submit(fire, b) for b in bodies]:
-            try:
-                latencies.append(fut.result())
-            except Exception as e:
-                errors.append(f"{type(e).__name__}: {e}")
-    wall_s = time.monotonic() - t_start
+        t_start = time.monotonic()
+        errors = []
+        latencies = []
+        with ThreadPoolExecutor(max_workers=concurrency) as pool:
+            for fut in [pool.submit(fire, b) for b in bodies]:
+                try:
+                    latencies.append(fut.result())
+                except Exception as e:
+                    errors.append(f"{type(e).__name__}: {e}")
+        wall_s = time.monotonic() - t_start
 
-    latencies.sort()
-    from deeplearning4j_tpu.serving import ServingMetrics
-    p50 = ServingMetrics._percentile(latencies, 0.50)
-    p99 = ServingMetrics._percentile(latencies, 0.99)
-    snap = server._metrics_snapshot()
-    server.stop()
+        latencies.sort()
+        from deeplearning4j_tpu.serving import ServingMetrics
+        p50 = ServingMetrics._percentile(latencies, 0.50)
+        p99 = ServingMetrics._percentile(latencies, 0.99)
+        snap = server._metrics_snapshot()
+        server.stop()
+    finally:
+        lock_report = lock_sanitizer.report()
+        lock_sanitizer.uninstall()
 
     summary = {
         "n_requests": n_requests,
@@ -94,10 +104,13 @@ def run(n_requests=200, concurrency=16, max_rows=4, p99_budget_ms=10000.0,
         "batch_size_histogram": snap["batch_size_histogram"],
         "shed": snap["shed"],
         "server_latency_ms": snap["latency_ms"],
+        "lock_sanitizer": lock_report,
     }
     assert not errors, f"{len(errors)} failed requests: {errors[:3]}"
     assert snap["shed"] == 0, f"unexpected shedding: {snap['shed']}"
     assert p99 <= p99_budget_ms, f"p99 {p99:.1f}ms > budget {p99_budget_ms}ms"
+    assert lock_report["violations"] == 0, \
+        f"lock sanitizer: {lock_sanitizer.table()['violations']}"
     return summary
 
 
